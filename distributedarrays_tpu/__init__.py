@@ -1,0 +1,29 @@
+"""distributedarrays_tpu — a TPU-native distributed-array framework.
+
+A ground-up re-design of the capabilities of
+JuliaParallel/DistributedArrays.jl (reference mounted at /root/reference)
+for TPU: the global array is one sharded ``jax.Array`` over a device
+``Mesh``; elementwise, reduction, and linear-algebra ops are jitted XLA
+programs whose cross-chip communication is compiler-inserted collectives
+over ICI; the MPI-style SPMD mode lowers to ``shard_map`` + ``lax.ppermute``
+/ ``psum`` / ``all_to_all`` for static patterns with a host-eager
+rank/mailbox runtime for fully dynamic send/recv.
+
+See SURVEY.md at the repo root for the layer-by-layer mapping.
+"""
+
+from .core import (allowscalar, close, d_closeall, next_did, procs, registry,
+                   live_ids, current_rank)
+from .darray import (DArray, SubDArray, SubOrDArray, DData, darray,
+                     darray_like, from_chunks, dzeros, dones, dfill, drand,
+                     drandn, distribute, ddata, gather, localpart,
+                     localindices, locate, makelocal, seed)
+from .layout import (defaultdist, defaultdist_1d, chunk_idxs, mesh_for,
+                     sharding_for, nranks, all_ranks)
+from .ops.broadcast import dmap, dmap_into, djit, broadcasted
+from .ops.mapreduce import (dreduce, dmapreduce, dsum, dprod, dmaximum,
+                            dminimum, dmean, dstd, dvar, dall, dany, dcount,
+                            dextrema, map_localparts, map_localparts_into,
+                            samedist, mapslices, ppeval)
+
+__version__ = "0.1.0"

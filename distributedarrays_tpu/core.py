@@ -1,0 +1,140 @@
+"""Object lifecycle: IDs, the live-array registry, and the release protocol.
+
+TPU-native counterpart of /root/reference/src/core.jl.  The reference needs a
+distributed GC — a creator-side ref set (core.jl:30-52), an all-nodes registry
+of id → WeakRef (core.jl:1-28) and a finalizer-driven release fan-out
+(core.jl:67-105) — because chunks live in remote worker processes.  Under
+single-controller JAX the controller owns every buffer handle, so lifecycle
+collapses to: Python refcounting + ``jax.Array.delete()`` to drop HBM eagerly.
+We keep the same *observable* surface for parity and for the leak-checking
+test discipline (reference test/runtests.jl:28-37, test/darray.jl:1079-1086):
+
+- ``next_did()``       — atomic id generation (core.jl:55-65)
+- ``registry()``       — id → weakref of every live DArray
+- ``close(d)``         — eager release of d's device buffers (core.jl:92-105)
+- ``d_closeall()``     — close every DArray created here (core.jl:95-103)
+- ``refcount_report`` / ``check_leaks`` helpers for tests
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import weakref
+
+__all__ = ["next_did", "d_closeall", "close", "registry", "live_ids", "procs"]
+
+_id_counter = itertools.count(1)
+_id_lock = threading.Lock()
+
+# thread-local SPMD rank: 0 on the controller thread, set per-task by
+# parallel.spmd (the reference's `myid()` analog for localpart addressing)
+_rank_tls = threading.local()
+
+
+def current_rank() -> int:
+    return getattr(_rank_tls, "rank", 0)
+
+# id -> weakref.ref(DArray).  Mirrors the reference REGISTRY (core.jl:1-28);
+# the lock mirrors its ReentrantLock discipline.
+_registry: dict[tuple[int, int], "weakref.ref"] = {}
+_registry_lock = threading.Lock()
+
+
+def next_did() -> tuple[int, int]:
+    """Fresh DArray id ``(controller_pid, seq)``.
+
+    The reference returns ``(myid(), atomic_add!(DID))`` (core.jl:55-65); the
+    single controller is always pid 0 here, kept as a tuple for parity.
+    """
+    with _id_lock:
+        return (0, next(_id_counter))
+
+
+def register(d) -> None:
+    with _registry_lock:
+        _registry[d.id] = weakref.ref(d)
+
+
+def unregister(did) -> None:
+    with _registry_lock:
+        _registry.pop(did, None)
+
+
+def registry() -> dict:
+    """Snapshot of the live registry (for tests / leak checks)."""
+    with _registry_lock:
+        return {k: v for k, v in _registry.items() if v() is not None}
+
+
+def live_ids() -> list[tuple[int, int]]:
+    return sorted(registry().keys())
+
+
+def close(d) -> None:
+    """Eagerly release ``d``'s device buffers (reference ``Base.close(d)``,
+    core.jl:105; release fan-out core.jl:68-84 becomes a local delete)."""
+    d._close()
+
+
+def d_closeall() -> None:
+    """Close every live DArray (reference ``d_closeall``, core.jl:95-103)."""
+    with _registry_lock:
+        refs = list(_registry.values())
+        _registry.clear()
+    for r in refs:
+        d = r()
+        if d is not None:
+            d._close(_unregister=False)
+
+
+def procs(d):
+    """Process/rank grid of ``d`` (reference ``procs(::DArray)``, core.jl:112)."""
+    return d.pids
+
+
+# ---------------------------------------------------------------------------
+# Scalar-indexing guard (reference darray.jl:637-648, exported `allowscalar`)
+# ---------------------------------------------------------------------------
+
+_allowscalar = threading.local()
+
+
+def allowscalar(flag: bool | None = None):
+    """Get/set whether scalar ``getindex``/``setindex`` on a DArray is allowed.
+
+    Mirrors /root/reference/src/darray.jl:641-645.  Scalar reads gather one
+    element from device to host — a performance trap the tests ban globally
+    (reference test/runtests.jl:5-7).  Usable as a context manager::
+
+        with allowscalar(True):
+            x = d[3, 4]
+    """
+    if flag is None:
+        return getattr(_allowscalar, "flag", False)
+    return _AllowScalar(flag)
+
+
+class _AllowScalar:
+    def __init__(self, flag: bool):
+        self._prev = getattr(_allowscalar, "flag", False)
+        _allowscalar.flag = bool(flag)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _allowscalar.flag = self._prev
+        return False
+
+    def __bool__(self):
+        return getattr(_allowscalar, "flag", False)
+
+
+def _scalar_indexing_allowed():
+    if not getattr(_allowscalar, "flag", False):
+        raise RuntimeError(
+            "scalar indexing of a DArray is disabled; it gathers one element "
+            "per call from device HBM. Use allowscalar(True) (context manager) "
+            "to permit it explicitly. [reference darray.jl:638-640]"
+        )

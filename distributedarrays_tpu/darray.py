@@ -1,0 +1,884 @@
+"""The DArray: a global-view distributed array backed by a sharded jax.Array.
+
+TPU-native re-design of /root/reference/src/darray.jl (834 LoC).  The
+reference keeps per-worker chunks in remote Julia processes and stitches them
+together with eager RPC; here the *global* array is a single ``jax.Array``
+laid out across the device mesh by ``NamedSharding``, and every operation is
+a traced/compiled XLA program over it — communication is compiler-inserted
+collectives over ICI, not messages.
+
+What survives from the reference is the user-visible layout model
+(darray.jl:25-55): an explicit N-D chunk grid (``pids``), per-chunk global
+index ranges (``indices``), per-dimension cut vectors (``cuts``), uneven
+chunks included, plus ``localpart``/``localindices``/``locate`` and the
+constructor family (``dzeros dones dfill drand drandn distribute ddata``).
+
+Mutation semantics: ``jax.Array`` is immutable, so the mutating API
+(``fill_``, ``d[...] = v``, ``map_into``) rebinds the underlying buffer
+inside the same ``DArray`` wrapper — user-visible semantics match the
+reference's in-place ops (darray.jl:822-834) without fighting XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+import numbers
+import weakref
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+from . import layout as L
+from .core import allowscalar, _scalar_indexing_allowed
+
+__all__ = [
+    "DArray",
+    "SubDArray",
+    "DData",
+    "darray",
+    "darray_like",
+    "from_chunks",
+    "dzeros",
+    "dones",
+    "dfill",
+    "drand",
+    "drandn",
+    "distribute",
+    "ddata",
+    "gather",
+    "localpart",
+    "localindices",
+    "locate",
+    "makelocal",
+    "allowscalar",
+    "seed",
+    "current_rank",
+]
+
+
+# ---------------------------------------------------------------------------
+# RNG plumbing (reference uses per-worker GLOBAL_RNG; we keep one controller
+# key-chain so results are reproducible under `seed`)
+# ---------------------------------------------------------------------------
+
+# created lazily so that `import distributedarrays_tpu` has no JAX
+# backend-initialization side effect (users must be able to set jax.config
+# after importing this package)
+_rng_key = None
+
+
+def seed(n: int) -> None:
+    """Reset the controller RNG chain (reference: per-worker Random.seed!,
+    test/runtests.jl:23)."""
+    global _rng_key
+    _rng_key = jax.random.key(n)
+
+
+def _next_key():
+    global _rng_key
+    if _rng_key is None:
+        _rng_key = jax.random.key(1234)
+    _rng_key, sub = jax.random.split(_rng_key)
+    return sub
+
+
+def current_rank() -> int:
+    """Rank of the calling SPMD task, 0 on the controller (reference:
+    ``myid()``)."""
+    return core.current_rank()
+
+
+# ---------------------------------------------------------------------------
+# cached jitted helpers (jit wrappers are cached so XLA compile caches stay warm)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _filler(kind: str, dims: tuple, dtype, sharding):
+    if kind == "fill":
+        fn = lambda v: jnp.full(dims, v, dtype)
+    elif kind == "rand":
+        fn = lambda key: jax.random.uniform(key, dims, dtype=dtype)
+    elif kind == "randn":
+        fn = lambda key: jax.random.normal(key, dims, dtype=dtype)
+    else:  # pragma: no cover
+        raise ValueError(kind)
+    return jax.jit(fn, out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _resharder(sharding):
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
+# ---------------------------------------------------------------------------
+# DArray
+# ---------------------------------------------------------------------------
+
+
+class DArray:
+    """Global-view distributed array (reference ``mutable struct DArray``,
+    darray.jl:25-55).
+
+    Fields mirror the reference: ``id`` (registry key), ``dims`` (global
+    shape), ``pids`` (N-D grid of owning device ranks), ``indices`` (grid of
+    per-chunk global index ranges), ``cuts`` (per-dim cut vectors).  The
+    payload is ``_data``: one sharded ``jax.Array`` whose NamedSharding axes
+    follow the chunk grid.
+    """
+
+    __slots__ = (
+        "id",
+        "dims",
+        "pids",
+        "indices",
+        "cuts",
+        "_data",
+        "_sharding",
+        "_closed",
+        "__weakref__",
+    )
+
+    def __init__(self, data: jax.Array, pids: np.ndarray, indices: np.ndarray,
+                 cuts: list, did=None):
+        self.id = did if did is not None else core.next_did()
+        self.dims = tuple(int(s) for s in data.shape)
+        self.pids = pids
+        self.indices = indices
+        self.cuts = cuts
+        self._data = data
+        self._sharding = data.sharding
+        self._closed = False
+        core.register(self)
+        # finalizer → close_by_id fan-out in the reference (darray.jl:47-49);
+        # here plain refcounting already frees HBM, the finalizer only
+        # keeps the registry tidy.
+        weakref.finalize(self, core.unregister, self.id)
+
+    # -- basic protocol ----------------------------------------------------
+
+    @property
+    def shape(self):
+        return self.dims
+
+    @property
+    def ndim(self):
+        return len(self.dims)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    @property
+    def sharding(self):
+        return self._sharding
+
+    @property
+    def garray(self) -> jax.Array:
+        """The underlying global sharded jax.Array (TPU-native escape hatch)."""
+        self._check_open()
+        return self._data
+
+    def __len__(self):
+        if not self.dims:
+            raise TypeError("len() of 0-d DArray")
+        return self.dims[0]
+
+    def __repr__(self):
+        grid = "x".join(str(s) for s in self.pids.shape) if self.pids.ndim else "1"
+        return (f"DArray(id={self.id}, dims={self.dims}, dtype={self.dtype}, "
+                f"chunks={grid}, ranks={sorted(int(p) for p in set(self.pids.flat))})")
+
+    def __hash__(self):
+        # reference hashes on the id (darray.jl:72)
+        return hash(self.id)
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._gather_host())
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        return a
+
+    def __iter__(self):
+        # iterating gathers — guard like scalar indexing
+        _scalar_indexing_allowed()
+        return iter(np.asarray(self))
+
+    def __float__(self):
+        if self.size != 1:
+            raise TypeError("only size-1 DArray converts to float")
+        return float(np.asarray(self).reshape(()))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _check_open(self):
+        if self._closed:
+            raise RuntimeError(f"DArray {self.id} is closed")
+
+    def _close(self, _unregister=True):
+        if not self._closed:
+            self._closed = True
+            try:
+                self._data.delete()
+            except Exception:
+                pass
+            self._data = None
+            if _unregister:
+                core.unregister(self.id)
+
+    def close(self):
+        """Release device buffers now (reference ``close(d)``, core.jl:105)."""
+        self._close()
+
+    def _release_wrapper(self):
+        """Drop this wrapper from the registry WITHOUT deleting the buffer —
+        used when buffer ownership moved into another DArray."""
+        self._closed = True
+        self._data = None
+        core.unregister(self.id)
+
+    # -- layout queries ----------------------------------------------------
+
+    def localpartindex(self, pid: int | None = None) -> tuple | None:
+        """Grid coordinates of the chunk owned by ``pid`` (reference
+        ``localpartindex``, darray.jl:309-318); None if not a participant."""
+        pid = current_rank() if pid is None else pid
+        hits = np.argwhere(self.pids == pid)
+        if hits.size == 0:
+            return None
+        return tuple(int(x) for x in hits[0])
+
+    def localindices(self, pid: int | None = None) -> tuple:
+        """Global index ranges of this rank's chunk (darray.jl:394-400)."""
+        ci = self.localpartindex(pid)
+        if ci is None:
+            return tuple(range(0, 0) for _ in self.dims)
+        return self.indices[ci]
+
+    def localpart(self, pid: int | None = None) -> jax.Array:
+        """This rank's chunk of the global array (darray.jl:330-339).
+
+        Fast path: when the logical layout coincides with the physical XLA
+        shard layout, this returns the addressable shard with no copy;
+        otherwise the logical chunk is sliced out of the global array.
+        """
+        self._check_open()
+        ci = self.localpartindex(pid)
+        if ci is None:
+            return jnp.empty((0,) * max(self.ndim, 1), dtype=self.dtype)
+        idx = self.indices[ci]
+        shard = self._physical_shard_matching(idx)
+        if shard is not None:
+            return shard
+        return self._data[tuple(slice(r.start, r.stop) for r in idx)]
+
+    def _physical_shard_matching(self, idx):
+        try:
+            for s in self._data.addressable_shards:
+                sl = s.index
+                if len(sl) == len(idx) and all(
+                    (x.start or 0) == r.start and (x.stop if x.stop is not None else self.dims[d]) == r.stop
+                    for d, (x, r) in enumerate(zip(sl, idx))
+                ):
+                    return s.data
+        except Exception:
+            pass
+        return None
+
+    @property
+    def lp(self):
+        """Sugar for ``localpart`` (reference ``d[:L]``, darray.jl:371-382)."""
+        return self.localpart()
+
+    @lp.setter
+    def lp(self, value):
+        self.set_localpart(value)
+
+    def set_localpart(self, value, pid: int | None = None):
+        """Replace this rank's chunk (reference ``d[:L] = v``, darray.jl:378-382)."""
+        self._check_open()
+        ci = self.localpartindex(pid)
+        if ci is None:
+            raise ValueError(f"rank {pid if pid is not None else current_rank()} "
+                             f"holds no chunk of {self!r}")
+        idx = self.indices[ci]
+        value = jnp.asarray(value, dtype=self.dtype)
+        want = tuple(len(r) for r in idx)
+        if value.shape != want:
+            raise ValueError(f"localpart shape {value.shape} != chunk shape {want}")
+        sl = tuple(slice(r.start, r.stop) for r in idx)
+        self._rebind(self._data.at[sl].set(value))
+
+    def locate(self, *I: int) -> tuple:
+        """Chunk-grid coordinates owning global index I (darray.jl:448-456)."""
+        return L.locate(self.cuts, *I)
+
+    def chunk(self, pid: int) -> jax.Array:
+        """Chunk owned by ``pid`` (reference ``chunk(d, pid)``, darray.jl:458)."""
+        return self.localpart(pid)
+
+    def procs(self):
+        return self.pids
+
+    # -- data movement -----------------------------------------------------
+
+    def _gather_host(self):
+        self._check_open()
+        return jax.device_get(self._data)
+
+    def _rebind(self, new_data: jax.Array):
+        """Swap the backing buffer in place (mutation-API support)."""
+        self._check_open()
+        if new_data.shape != tuple(self.dims):
+            raise ValueError("rebind shape mismatch")
+        if new_data.sharding != self._sharding:
+            new_data = _resharder(self._sharding)(new_data)
+        self._data = new_data
+
+    def with_data(self, new_data: jax.Array, did=None) -> "DArray":
+        """New DArray with this layout and ``new_data`` (same global shape)."""
+        return DArray(_to_sharding(new_data, self._sharding), self.pids.copy(),
+                      self.indices, self.cuts, did=did)
+
+    # -- indexing ----------------------------------------------------------
+
+    def __getitem__(self, key):
+        self._check_open()
+        key = _normalize_key(key, self.dims)
+        if all(isinstance(k, int) for k in key):
+            # scalar read: guarded remote fetch (darray.jl:649-659)
+            _scalar_indexing_allowed()
+            return self._data[tuple(key)]
+        # range indexing returns a lazy view (darray.jl:661)
+        return SubDArray(self, key)
+
+    def __setitem__(self, key, value):
+        self._check_open()
+        key = _normalize_key(key, self.dims)
+        if all(isinstance(k, int) for k in key):
+            _scalar_indexing_allowed()
+        if isinstance(value, DArray):
+            value = value.garray
+        elif isinstance(value, SubDArray):
+            value = value.materialize()
+        self._rebind(self._data.at[tuple(key)].set(value))
+
+    def makelocal(self, *I) -> jax.Array:
+        """Materialize the region ``I`` as a dense local array
+        (reference ``makelocal``, darray.jl:345-368: local view when the
+        region lies within this rank's chunk, else a gathering copy — under
+        single-controller JAX both are an XLA slice)."""
+        self._check_open()
+        if not I:
+            return self._data
+        key = _normalize_key(tuple(I) if len(I) > 1 else I[0], self.dims)
+        key = tuple(slice(k, k + 1) if isinstance(k, int) else k for k in key)
+        return self._data[key]
+
+    # -- conveniences ------------------------------------------------------
+
+    def copy(self) -> "DArray":
+        """Independent copy with the same layout (darray.jl:689-697)."""
+        return self.with_data(jnp.copy(self.garray))
+
+    def __eq__(self, other):
+        # whole-array equality, like the reference's Base.== (darray.jl:403-441)
+        if isinstance(other, (DArray, SubDArray)):
+            other = np.asarray(other)
+        elif not isinstance(other, (np.ndarray, jax.Array)):
+            return NotImplemented
+        if tuple(np.shape(other)) != self.dims:
+            return False
+        return bool(jnp.array_equal(self.garray, jnp.asarray(other)))
+
+    def __ne__(self, other):
+        r = self.__eq__(other)
+        return NotImplemented if r is NotImplemented else not r
+
+    def reshape(self, *dims) -> "DArray":
+        """Reshaped copy with a default layout for the new dims
+        (reference reshape(::DVector, dims), darray.jl:612-635)."""
+        if len(dims) == 1 and isinstance(dims[0], (tuple, list)):
+            dims = tuple(dims[0])
+        dims = tuple(int(d) for d in dims)
+        if int(np.prod(dims)) != self.size:
+            raise ValueError(f"cannot reshape size {self.size} into {dims}")
+        pids = sorted(set(int(p) for p in self.pids.flat))
+        return _wrap_global(jnp.reshape(self.garray, dims), procs=pids)
+
+    def astype(self, dtype) -> "DArray":
+        return self.with_data(self.garray.astype(dtype))
+
+    def fill_(self, x) -> "DArray":
+        """In-place fill (reference ``fill!``, darray.jl:822-827)."""
+        sh = self._sharding
+        self._rebind(_filler("fill", self.dims, np.dtype(self.dtype), sh)(
+            jnp.asarray(x, dtype=self.dtype)))
+        return self
+
+    def rand_(self) -> "DArray":
+        """In-place uniform refill (reference ``rand!``, darray.jl:829-834)."""
+        self._rebind(_filler("rand", self.dims, np.dtype(self.dtype),
+                             self._sharding)(_next_key()))
+        return self
+
+
+# ---------------------------------------------------------------------------
+# SubDArray: lazy view (reference SubDArray = SubArray{...,DArray},
+# darray.jl:64-65; materialization logic darray.jl:584-602,699-820)
+# ---------------------------------------------------------------------------
+
+
+class SubDArray:
+    """A lazy view of a region of a DArray.
+
+    The reference's SubDArray→Array machinery (darray.jl:699-820) hand-rolls
+    per-chunk index algebra because chunks live in other processes; on a
+    global-view jax.Array the same semantics are one XLA gather, so this
+    class only carries (parent, index) and materializes on demand.
+    """
+
+    __slots__ = ("parent", "key")
+
+    def __init__(self, parent: DArray, key: tuple):
+        self.parent = parent
+        self.key = key
+
+    @property
+    def shape(self):
+        return _result_shape(self.key, self.parent.dims)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return self.parent.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def materialize(self) -> jax.Array:
+        """Dense jax.Array of the viewed region (reference Array(::SubDArray),
+        darray.jl:584-596, incl. the whole-chunk fast path via locate)."""
+        self.parent._check_open()
+        key = tuple(slice(k, k + 1) if isinstance(k, int) else k for k in self.key)
+        out = self.parent.garray[key]
+        # squeeze integer-indexed dims like numpy basic indexing
+        squeeze = tuple(i for i, k in enumerate(self.key) if isinstance(k, int))
+        if squeeze:
+            out = jnp.squeeze(out, axis=squeeze)
+        return out
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(jax.device_get(self.materialize()))
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        return a
+
+    def copy(self) -> DArray:
+        """Distribute the viewed region as a fresh DArray (reference
+        ``copy(::SubDArray)``, darray.jl:676-677)."""
+        return distribute(self.materialize())
+
+    def __getitem__(self, key):
+        return self.materialize()[key]
+
+    def __eq__(self, other):
+        if isinstance(other, (DArray, SubDArray)):
+            other = np.asarray(other)
+        elif not isinstance(other, (np.ndarray, jax.Array)):
+            return NotImplemented
+        if tuple(np.shape(other)) != tuple(self.shape):
+            return False
+        return bool(jnp.array_equal(self.materialize(), jnp.asarray(other)))
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        return f"SubDArray(parent={self.parent.id}, key={self.key}, shape={self.shape})"
+
+
+SubOrDArray = (DArray, SubDArray)
+
+
+# ---------------------------------------------------------------------------
+# index normalization helpers
+# ---------------------------------------------------------------------------
+
+
+def _normalize_key(key, dims):
+    if not isinstance(key, tuple):
+        key = (key,)
+    if any(k is Ellipsis for k in key):
+        i = key.index(Ellipsis)
+        fill = len(dims) - (len(key) - 1)
+        key = key[:i] + (slice(None),) * fill + key[i + 1:]
+    if len(key) < len(dims):
+        key = key + (slice(None),) * (len(dims) - len(key))
+    if len(key) > len(dims):
+        raise IndexError(f"too many indices for {len(dims)}-d DArray")
+    out = []
+    for d, k in enumerate(key):
+        n = dims[d]
+        if isinstance(k, (int, np.integer)):
+            k = int(k)
+            if k < 0:
+                k += n
+            if not (0 <= k < n):
+                raise IndexError(f"index {k} out of bounds for dim {d} (size {n})")
+            out.append(k)
+        elif isinstance(k, slice):
+            out.append(slice(*k.indices(n)))
+        elif isinstance(k, range):
+            out.append(slice(k.start, k.stop, k.step))
+        else:
+            out.append(jnp.asarray(k))
+    return tuple(out)
+
+
+def _result_shape(key, dims):
+    shape = []
+    for d, k in enumerate(key):
+        if isinstance(k, int):
+            continue
+        if isinstance(k, slice):
+            shape.append(len(range(*k.indices(dims[d]))))
+        else:
+            shape.append(int(np.shape(k)[0]))
+    return tuple(shape)
+
+
+# ---------------------------------------------------------------------------
+# construction
+# ---------------------------------------------------------------------------
+
+
+def _resolve_layout(dims, procs=None, dist=None):
+    dims = tuple(int(d) for d in dims)
+    if procs is None:
+        procs = L.all_ranks()
+    procs = list(procs)
+    if dist is None:
+        dist = L.defaultdist(dims, procs)
+    dist = [int(c) for c in dist]
+    if len(dist) != len(dims):
+        raise ValueError(f"dist {dist} rank != dims {dims} rank")
+    n = int(np.prod(dist)) if dist else 1
+    if n > len(procs):
+        raise ValueError(f"layout {dist} needs {n} ranks, have {len(procs)}")
+    use = procs[:n]
+    idxs, cuts = L.chunk_idxs(dims, dist)
+    pids = np.asarray(use, dtype=np.int64).reshape(tuple(dist) if dist else ())
+    sharding = L.sharding_for(use, dist, dims)
+    return dims, pids, idxs, cuts, sharding
+
+
+def _wrap_global(data: jax.Array, procs=None, dist=None) -> DArray:
+    dims, pids, idxs, cuts, sharding = _resolve_layout(data.shape, procs, dist)
+    return DArray(_to_sharding(data, sharding), pids, idxs, cuts)
+
+
+def _to_sharding(data: jax.Array, sharding) -> jax.Array:
+    if getattr(data, "sharding", None) == sharding:
+        return data
+    return jax.device_put(data, sharding)
+
+
+def darray(init: Callable, dims, procs=None, dist=None) -> DArray:
+    """Build a DArray by calling ``init(index_ranges)`` once per chunk.
+
+    Reference: generic ctor darray.jl:76-118 (asyncmap of remote
+    ``construct_localparts``).  Arbitrary Python init closures are not
+    XLA-traceable, so this runs eagerly on host per chunk and ships the
+    assembled global array once (`jax.device_put` scatters per the sharding —
+    the moral equivalent of the reference's DestinationSerializer,
+    serialize.jl:45-87).  Use dzeros/drand/... for the compiled fast path.
+    """
+    dims, pids, idxs, cuts, sharding = _resolve_layout(dims, procs, dist)
+    parts = {}
+    dtype = None
+    for ci in np.ndindex(*pids.shape) if pids.shape else [()]:
+        p = np.asarray(init(idxs[ci]))
+        want = tuple(len(r) for r in idxs[ci])
+        if p.shape != want:
+            raise ValueError(
+                f"init returned shape {p.shape} for chunk {ci}, expected {want}")
+        # homogeneity check: all chunks must agree on dtype, else the ctor
+        # rolls back (reference darray.jl:89-94)
+        if dtype is None:
+            dtype = p.dtype
+        elif p.dtype != dtype:
+            raise TypeError(
+                f"chunk dtypes differ: {dtype} vs {p.dtype} "
+                "(reference requires homogeneous localparts, darray.jl:89-94)")
+        parts[ci] = p
+    host = np.empty(dims, dtype=dtype)
+    for ci, p in parts.items():
+        host[tuple(slice(r.start, r.stop) for r in idxs[ci])] = p
+    return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
+
+
+def darray_like(init: Callable, d: DArray) -> DArray:
+    """Same-layout ctor (reference ``DArray(init, d::DArray)``, darray.jl:234)."""
+    pids = [int(p) for p in d.pids.flat]
+    return darray(init, d.dims, pids, list(d.pids.shape))
+
+
+def from_chunks(chunks: np.ndarray, procs=None) -> DArray:
+    """Assemble a DArray from an object-grid of host/device chunks,
+    reconstructing indices/cuts from chunk sizes (reference from-refs ctor,
+    darray.jl:182-212).  Chunk sizes may be uneven; empty chunks are kept."""
+    if isinstance(chunks, (list, tuple)):
+        # a plain sequence is a 1-D grid of chunks; build the object array
+        # explicitly (np.asarray would stack equal-shaped chunks into a 2-D
+        # array of scalars)
+        seq = list(chunks)
+        chunks = np.empty(len(seq), dtype=object)
+        for i, c in enumerate(seq):
+            chunks[i] = c
+    else:
+        chunks = np.asarray(chunks, dtype=object)
+    grid = chunks.shape
+    nd = np.ndim(chunks.flat[0]) if chunks.size else 0
+    if len(grid) != nd:
+        raise ValueError(
+            f"chunk grid rank {len(grid)} must equal chunk ndim {nd} "
+            "(reference from-refs ctor, darray.jl:182-212)")
+    cuts = []
+    for d in range(nd):
+        c = [0]
+        for j in range(grid[d] if d < len(grid) else 1):
+            sel = [0] * len(grid)
+            sel[d] = j
+            c.append(c[-1] + int(np.shape(chunks[tuple(sel)])[d]))
+        cuts.append(c)
+    dims = tuple(c[-1] for c in cuts)
+    if procs is None:
+        procs = L.all_ranks()
+    n = int(np.prod(grid)) if grid else 1
+    pids = np.asarray(procs[:n], dtype=np.int64).reshape(grid)
+    idxs = np.empty(grid, dtype=object)
+    dtype = np.result_type(*[np.asarray(chunks[ci]).dtype
+                             for ci in np.ndindex(*grid)])
+    host = np.empty(dims, dtype=dtype)
+    for ci in np.ndindex(*grid):
+        rngs = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1]) for d in range(nd))
+        idxs[ci] = rngs
+        host[tuple(slice(r.start, r.stop) for r in rngs)] = np.asarray(chunks[ci])
+    sharding = L.sharding_for(list(pids.flat), grid, dims)
+    return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
+
+
+def dzeros(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
+    """Distributed zeros (reference dzeros, darray.jl:460-476)."""
+    dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
+    data = _filler("fill", dims, np.dtype(dtype), sh)(jnp.zeros((), dtype))
+    return DArray(data, pids, idxs, cuts)
+
+
+def dones(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
+    """Distributed ones (reference dones, darray.jl:478-482)."""
+    dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
+    data = _filler("fill", dims, np.dtype(dtype), sh)(jnp.ones((), dtype))
+    return DArray(data, pids, idxs, cuts)
+
+
+def dfill(v, dims, procs=None, dist=None) -> DArray:
+    """Distributed fill (reference dfill, darray.jl:484-499)."""
+    dims = _as_dims(dims)
+    v = jnp.asarray(v)
+    dims, pids, idxs, cuts, sh = _resolve_layout(dims, procs, dist)
+    data = _filler("fill", dims, np.dtype(v.dtype), sh)(v)
+    return DArray(data, pids, idxs, cuts)
+
+
+def drand(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
+    """Distributed uniform [0,1) (reference drand, darray.jl:501-519).
+
+    Generated *on device* with `jax.random` under jit with the target
+    sharding — no host round-trip (contrast with the reference's per-worker
+    host RNG)."""
+    dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
+    data = _filler("rand", dims, np.dtype(dtype), sh)(_next_key())
+    return DArray(data, pids, idxs, cuts)
+
+
+def drandn(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
+    """Distributed standard normal (reference drandn, darray.jl:521-532)."""
+    dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
+    data = _filler("randn", dims, np.dtype(dtype), sh)(_next_key())
+    return DArray(data, pids, idxs, cuts)
+
+
+def _as_dims(dims):
+    if isinstance(dims, (int, np.integer)):
+        return (int(dims),)
+    return tuple(int(d) for d in dims)
+
+
+def distribute(A, procs=None, dist=None, like: DArray | None = None) -> DArray:
+    """Distribute a host/device array (reference distribute, darray.jl:544-572).
+
+    ``jax.device_put`` with a NamedSharding performs the per-destination
+    scatter that the reference implements with its DestinationSerializer
+    (serialize.jl:45-87): each device receives only its own slice.
+    """
+    if isinstance(A, DArray):
+        A = A.garray
+    elif isinstance(A, SubDArray):
+        A = A.materialize()
+    A = jnp.asarray(A) if not isinstance(A, (np.ndarray, jax.Array)) else A
+    if like is not None:
+        dims, pids, idxs, cuts, sharding = _resolve_layout(
+            np.shape(A), [int(p) for p in like.pids.flat], list(like.pids.shape))
+    else:
+        dims, pids, idxs, cuts, sharding = _resolve_layout(np.shape(A), procs, dist)
+    return DArray(jax.device_put(A, sharding), pids, idxs, cuts)
+
+
+# ---------------------------------------------------------------------------
+# module-level parity functions
+# ---------------------------------------------------------------------------
+
+
+def localpart(d, pid: int | None = None):
+    """Chunk of ``d`` owned by ``pid`` / the current SPMD rank
+    (reference localpart, darray.jl:330-339).  Plain arrays are their own
+    localpart (darray.jl:341-343)."""
+    if isinstance(d, DArray):
+        return d.localpart(pid)
+    if isinstance(d, DData):
+        return d.localpart(pid)
+    if isinstance(d, SubDArray):
+        return d.materialize()
+    return d
+
+
+def localindices(d: DArray, pid: int | None = None):
+    if isinstance(d, DArray):
+        return d.localindices(pid)
+    return tuple(range(0, s) for s in np.shape(d))
+
+
+def locate(d: DArray, *I):
+    return d.locate(*I)
+
+
+def makelocal(d: DArray, *I):
+    if isinstance(d, DArray):
+        return d.makelocal(*I)
+    return jnp.asarray(d)[tuple(I)] if I else jnp.asarray(d)
+
+
+# ---------------------------------------------------------------------------
+# ddata: distributed non-array data (reference darray.jl:120-157)
+# ---------------------------------------------------------------------------
+
+
+class DData:
+    """A distributed container of arbitrary per-rank Python objects.
+
+    The reference builds this as ``DArray{T,1,T}`` whose localpart is a single
+    value (darray.jl:120-148).  Arbitrary objects are not expressible as one
+    jax.Array, so this is the host-object sharded container the survey calls
+    for (SURVEY.md §7 hard-parts); jax.Arrays placed in it are device_put to
+    their owner's device.
+    """
+
+    __slots__ = ("id", "pids", "_parts", "_closed", "__weakref__")
+
+    def __init__(self, parts: dict[int, Any], pids: list[int]):
+        self.id = core.next_did()
+        self.pids = np.asarray(pids, dtype=np.int64)
+        self._parts = parts
+        self._closed = False
+        core.register(self)
+        weakref.finalize(self, core.unregister, self.id)
+
+    @property
+    def dims(self):
+        return (len(self.pids),)
+
+    def localpart(self, pid: int | None = None):
+        pid = current_rank() if pid is None else pid
+        if pid not in self._parts:
+            raise KeyError(f"rank {pid} holds no part of this ddata")
+        return self._parts[pid]
+
+    def set_localpart(self, v, pid: int | None = None):
+        pid = current_rank() if pid is None else pid
+        self._parts[pid] = v
+
+    def gather(self) -> list:
+        """All parts in pid order (reference gather, darray.jl:150-157)."""
+        return [self._parts[int(p)] for p in self.pids]
+
+    def close(self):
+        self._closed = True
+        self._parts = {}
+        core.unregister(self.id)
+
+    def _close(self, _unregister=True):
+        self._closed = True
+        self._parts = {}
+        if _unregister:
+            core.unregister(self.id)
+
+    def __len__(self):
+        return len(self.pids)
+
+    def __repr__(self):
+        return f"DData(id={self.id}, ranks={list(self.pids)})"
+
+
+def ddata(*, init: Callable | None = None, pids: Sequence[int] | None = None,
+          data: Sequence | None = None) -> DData:
+    """Distributed per-rank values (reference ddata, darray.jl:120-148).
+
+    ``init(pididx)`` is called once per rank, or ``data`` (length divisible
+    by nranks) is split evenly across ranks."""
+    if pids is None:
+        pids = L.all_ranks()
+    pids = [int(p) for p in pids]
+    parts: dict[int, Any] = {}
+    if data is not None:
+        n = len(data)
+        if n % len(pids) != 0:
+            raise ValueError(f"data length {n} not divisible by {len(pids)} ranks")
+        per = n // len(pids)
+        for i, p in enumerate(pids):
+            chunk = data[i * per:(i + 1) * per]
+            parts[p] = chunk[0] if per == 1 else list(chunk)
+    elif init is not None:
+        for i, p in enumerate(pids):
+            parts[p] = init(i)
+    else:
+        for p in pids:
+            parts[p] = None
+    return DData(parts, pids)
+
+
+def gather(d):
+    """Gather distributed data to the controller.
+
+    - ``DData`` → list of per-rank parts (reference gather, darray.jl:150-157)
+    - ``DArray``/``SubDArray`` → dense numpy array (reference ``Array(d)``,
+      darray.jl:574-596)
+    """
+    if isinstance(d, DData):
+        return d.gather()
+    if isinstance(d, (DArray, SubDArray)):
+        return np.asarray(d)
+    return d

@@ -1,0 +1,242 @@
+"""Block-layout math: process grids, chunk boundaries, and mesh construction.
+
+TPU-native re-design of the reference's layout machinery
+(/root/reference/src/darray.jl:249-318):
+
+- ``defaultdist(dims, pids)``  — prime-factorize the process count and assign
+  the largest factors to the largest dimensions (darray.jl:251-276).
+- ``defaultdist(sz, nc)``      — 1-D cut points with the remainder spread over
+  the *leading* chunks (darray.jl:279-296); uneven chunks are first-class.
+- ``chunk_idxs(dims, chunks)`` — full N-D grid of per-chunk index ranges plus
+  the per-dimension cut vectors (darray.jl:299-307).
+- ``locate(cuts, *I)``         — binary-search the cuts for the owning chunk
+  (darray.jl:448-456).
+
+Unlike the reference (1-based, master/worker), everything here is 0-based and
+"process" means a *device rank*: an index into ``jax.devices()``.  The chunk
+grid maps onto a ``jax.sharding.Mesh`` whose axes are the distributed
+dimensions; XLA's GSPMD partitioner then owns the physical placement, while
+the logical cuts computed here remain the source of truth for the user-visible
+API (``localindices``, ``localpart``, chunk ownership).
+
+Note on uneven layouts: ``NamedSharding`` shards a non-divisible dimension in
+ceil-sized pieces (last shard short), whereas the reference spreads the
+remainder over the leading chunks.  We keep the reference's *logical* cuts for
+API parity; the physical XLA layout may differ at the ragged edge.  All
+compute is expressed on the global array, so this never changes results.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+from typing import Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "defaultdist",
+    "defaultdist_1d",
+    "chunk_idxs",
+    "locate",
+    "locate_point",
+    "mesh_for",
+    "sharding_for",
+    "prime_factors",
+    "nranks",
+    "all_ranks",
+]
+
+
+def nranks() -> int:
+    """Number of device ranks available (reference: ``nworkers()``)."""
+    return len(jax.devices())
+
+
+def all_ranks() -> list[int]:
+    """All device ranks (reference: ``workers()``; we have no master/worker
+    split — the single controller drives every device)."""
+    return list(range(len(jax.devices())))
+
+
+def prime_factors(n: int) -> list[int]:
+    """Prime factorization of ``n`` (ascending, with multiplicity).
+
+    Stands in for the reference's ``Primes.factor`` dependency
+    (/root/reference/src/darray.jl:251)."""
+    if n < 1:
+        raise ValueError(f"cannot factorize {n}")
+    out: list[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def defaultdist(dims: Sequence[int], pids: Sequence[int]) -> list[int]:
+    """Decide how many chunks each dimension is divided into.
+
+    Port of the algorithm at /root/reference/src/darray.jl:251-276: factorize
+    the number of processes and greedily assign the largest prime factors to
+    the dimensions with the most remaining extent.  A factor that fits no
+    dimension is dropped (fewer ranks end up used), matching the reference's
+    behavior of leaving workers idle rather than over-chunking.
+    """
+    dims = list(dims)
+    chunks = [1] * len(dims)
+    np_ = len(pids)
+    if np_ == 0:
+        raise ValueError("no processes")
+    if len(dims) == 0:
+        return chunks
+    remaining = list(dims)
+    for fac in sorted(prime_factors(np_), reverse=True):
+        # dimension with the largest remaining extent that can absorb `fac`
+        order = sorted(range(len(dims)), key=lambda i: remaining[i], reverse=True)
+        placed = False
+        for i in order:
+            if remaining[i] >= fac:
+                remaining[i] //= fac
+                chunks[i] *= fac
+                placed = True
+                break
+        if not placed:
+            # factor dropped: some ranks stay unused (darray.jl:262-270 spirit)
+            continue
+    return chunks
+
+
+def defaultdist_1d(sz: int, nc: int) -> list[int]:
+    """1-D cut points (0-based, length ``nc + 1``) splitting ``sz`` into
+    ``nc`` chunks, remainder spread over the *leading* chunks.
+
+    Port of /root/reference/src/darray.jl:279-296.  The reference's 1-based
+    ``defaultdist(50, 4) == [1, 14, 27, 39, 51]`` becomes
+    ``[0, 13, 26, 38, 50]`` here (chunk sizes 13, 13, 12, 12).
+    If ``sz < nc`` the first ``sz`` chunks have one element and the rest are
+    empty.
+    """
+    if nc <= 0:
+        raise ValueError(f"need at least one chunk, got {nc}")
+    if sz >= nc:
+        base, rem = divmod(sz, nc)
+        cuts = [0]
+        for i in range(nc):
+            cuts.append(cuts[-1] + base + (1 if i < rem else 0))
+        return cuts
+    # more chunks than elements: leading singleton chunks, trailing empties
+    return [min(i, sz) for i in range(nc + 1)]
+
+
+def chunk_idxs(dims: Sequence[int], chunks: Sequence[int]):
+    """Build the full chunk grid.
+
+    Returns ``(idxs, cuts)`` where ``cuts[d]`` is the 0-based cut vector for
+    dimension ``d`` and ``idxs`` is an object ndarray of shape ``chunks``
+    whose entry ``idxs[i, j, ...]`` is the tuple of ``range`` objects
+    addressing that chunk in the global array.
+
+    Port of /root/reference/src/darray.jl:299-307.
+    """
+    dims = tuple(dims)
+    chunks = tuple(chunks)
+    if len(dims) != len(chunks):
+        raise ValueError(f"dims {dims} and chunks {chunks} rank mismatch")
+    cuts = [defaultdist_1d(d, c) for d, c in zip(dims, chunks)]
+    idxs = np.empty(chunks, dtype=object)
+    for cidx in np.ndindex(*chunks) if chunks else [()]:
+        idxs[cidx] = tuple(
+            range(cuts[d][cidx[d]], cuts[d][cidx[d] + 1]) for d in range(len(dims))
+        )
+    return idxs, cuts
+
+
+def locate(cuts: Sequence[Sequence[int]], *I: int) -> tuple[int, ...]:
+    """Chunk-grid coordinates of global index ``I`` (0-based).
+
+    Port of /root/reference/src/darray.jl:448-456 (binary search of cuts).
+    """
+    out = []
+    for d, i in enumerate(I):
+        c = cuts[d]
+        if i < 0 or i >= c[-1]:
+            raise IndexError(f"index {i} out of bounds for dim {d} (size {c[-1]})")
+        # rightmost chunk j with c[j] <= i < c[j+1]; skip empty chunks
+        j = int(np.searchsorted(np.asarray(c), i, side="right")) - 1
+        while c[j + 1] == c[j]:  # land past empty chunks
+            j += 1
+        out.append(j)
+    return tuple(out)
+
+
+def locate_point(cuts, I):
+    return locate(cuts, *I)
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction
+# ---------------------------------------------------------------------------
+
+_mesh_lock = threading.Lock()
+_mesh_cache: dict[tuple, Mesh] = {}
+
+
+def mesh_for(pids: Sequence[int], chunks: Sequence[int]) -> Mesh:
+    """A ``jax.sharding.Mesh`` whose axes mirror the chunk grid.
+
+    This is the TPU-native replacement of the reference's
+    ``pids::Array{Int,N}`` process grid (/root/reference/src/darray.jl:28):
+    the grid of chunk owners *is* the device mesh, and communication between
+    chunks rides ICI collectives instead of TCP messages.
+
+    Meshes are cached so identical layouts share one ``Mesh`` object, which
+    keeps ``NamedSharding`` equality (and therefore jit caches) warm.
+    """
+    chunks = tuple(int(c) for c in chunks)
+    need = math.prod(chunks) if chunks else 1
+    use = tuple(int(p) for p in pids[:need])
+    if len(use) < need:
+        raise ValueError(f"layout {chunks} needs {need} ranks, got {len(pids)}")
+    key = (use, chunks)
+    with _mesh_lock:
+        m = _mesh_cache.get(key)
+        if m is None:
+            devs = np.asarray(jax.devices(), dtype=object)[list(use)].reshape(
+                chunks if chunks else (1,)
+            )
+            names = tuple(f"d{i}" for i in range(max(len(chunks), 1)))
+            m = Mesh(devs, axis_names=names)
+            _mesh_cache[key] = m
+        return m
+
+
+def sharding_for(pids: Sequence[int], chunks: Sequence[int],
+                 dims: Sequence[int] | None = None) -> NamedSharding:
+    """NamedSharding matching the chunk grid: dim ``i`` is split over mesh
+    axis ``d{i}``.
+
+    XLA shardings must divide evenly (jax requires ``dims[i] % chunks[i] ==
+    0``), while the reference supports uneven chunk grids
+    (darray.jl:279-296).  Resolution: a dimension that does not divide
+    evenly is left *physically* unsharded (replicated over that mesh axis);
+    the logical cuts remain the source of truth for ``localpart`` /
+    ``localindices`` semantics.  Even layouts — the performance path — get
+    the full distributed sharding.
+    """
+    mesh = mesh_for(pids, chunks)
+    if not chunks:
+        return NamedSharding(mesh, P())
+    names = []
+    for i, c in enumerate(chunks):
+        even = dims is None or (c > 0 and dims[i] % c == 0)
+        names.append(f"d{i}" if (c > 1 and even) else None)
+    return NamedSharding(mesh, P(*names))

@@ -1,0 +1,1 @@
+from . import broadcast, mapreduce  # noqa: F401

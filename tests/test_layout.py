@@ -1,0 +1,89 @@
+"""Layout math tests (reference semantics: /root/reference/src/darray.jl:249-318,
+regression values from test/darray.jl:61-67 shifted to 0-based)."""
+
+import numpy as np
+import pytest
+
+from distributedarrays_tpu import layout as L
+
+
+def test_prime_factors():
+    assert L.prime_factors(1) == []
+    assert L.prime_factors(8) == [2, 2, 2]
+    assert L.prime_factors(12) == [2, 2, 3]
+    assert L.prime_factors(13) == [13]
+
+
+def test_defaultdist_1d_even():
+    assert L.defaultdist_1d(100, 4) == [0, 25, 50, 75, 100]
+
+
+def test_defaultdist_1d_uneven_leading_remainder():
+    # reference: defaultdist(50, 4) == [1,14,27,39,51]  (test/darray.jl:66)
+    assert L.defaultdist_1d(50, 4) == [0, 13, 26, 38, 50]
+
+
+def test_defaultdist_1d_more_chunks_than_elements():
+    # reference darray.jl:290-295: leading singleton chunks, trailing empty
+    assert L.defaultdist_1d(3, 5) == [0, 1, 2, 3, 3, 3]
+
+
+def test_defaultdist_nd_factor_assignment():
+    # 8 ranks over a square matrix: largest factors to largest dims
+    chunks = L.defaultdist((100, 100), list(range(8)))
+    assert int(np.prod(chunks)) == 8
+    # 1-D vector: all chunks on the single dim
+    assert L.defaultdist((1000,), list(range(8))) == [8]
+    # skinny matrix: chunking should favor the long dim
+    chunks = L.defaultdist((10000, 4), list(range(8)))
+    assert chunks[0] >= chunks[1]
+
+
+def test_defaultdist_drops_unplaceable_factors():
+    # dims too small to absorb all factors → fewer ranks used, never
+    # over-chunked past the array extent
+    chunks = L.defaultdist((2,), list(range(8)))
+    assert chunks[0] <= 2
+
+
+def test_chunk_idxs_grid():
+    idxs, cuts = L.chunk_idxs((50, 8), (4, 2))
+    assert cuts[0] == [0, 13, 26, 38, 50]
+    assert cuts[1] == [0, 4, 8]
+    assert idxs.shape == (4, 2)
+    assert idxs[0, 0] == (range(0, 13), range(0, 4))
+    assert idxs[3, 1] == (range(38, 50), range(4, 8))
+    # chunks tile the array exactly
+    total = sum(len(t[0]) * len(t[1]) for t in idxs.flat)
+    assert total == 50 * 8
+
+
+def test_locate():
+    _, cuts = L.chunk_idxs((50, 8), (4, 2))
+    assert L.locate(cuts, 0, 0) == (0, 0)
+    assert L.locate(cuts, 12, 3) == (0, 0)
+    assert L.locate(cuts, 13, 4) == (1, 1)
+    assert L.locate(cuts, 49, 7) == (3, 1)
+    with pytest.raises(IndexError):
+        L.locate(cuts, 50, 0)
+
+
+def test_locate_skips_empty_chunks():
+    cuts = [L.defaultdist_1d(3, 5)]
+    assert L.locate(cuts, 2) == (2,)
+
+
+def test_mesh_cache_and_sharding():
+    m1 = L.mesh_for(range(8), (4, 2))
+    m2 = L.mesh_for(range(8), (4, 2))
+    assert m1 is m2
+    sh = L.sharding_for(range(8), (4, 2))
+    assert sh.mesh.shape == {"d0": 4, "d1": 2}
+    # single-chunk dims are unsharded in the spec
+    sh2 = L.sharding_for(range(4), (4, 1))
+    assert sh2.spec == ("d0", None) or tuple(sh2.spec) == ("d0", None)
+
+
+def test_mesh_for_too_few_ranks():
+    with pytest.raises(ValueError):
+        L.mesh_for(range(4), (4, 2))

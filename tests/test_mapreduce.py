@@ -1,0 +1,170 @@
+"""Map/reduce tests (reference src/mapreduce.jl semantics; oracle = numpy,
+mirroring e.g. test/darray.jl:398-441 reduction checks)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import DArray
+
+
+@pytest.fixture
+def dA(rng):
+    A = rng.standard_normal((40, 24)).astype(np.float32)
+    return A, dat.distribute(A, procs=range(8), dist=(4, 2))
+
+
+def test_whole_array_reductions(dA):
+    A, d = dA
+    assert np.allclose(float(dat.dsum(d)), A.sum(), rtol=1e-4)
+    assert np.allclose(float(dat.dmaximum(d)), A.max())
+    assert np.allclose(float(dat.dminimum(d)), A.min())
+    assert np.allclose(float(dat.dmean(d)), A.mean(), rtol=1e-5)
+    assert np.allclose(float(dat.dstd(d)), A.std(ddof=1), rtol=1e-4)
+    assert np.allclose(float(dat.dvar(d, ddof=1)), A.var(ddof=1), rtol=1e-4)
+
+
+def test_mapreduce(dA):
+    A, d = dA
+    # mapreduce(abs2, +, D) — BASELINE config 2 semantics
+    got = float(dat.dmapreduce(jnp.square, "sum", d))
+    assert np.allclose(got, (A ** 2).sum(), rtol=1e-4)
+    got = float(dat.dmapreduce(jnp.abs, "max", d))
+    assert np.allclose(got, np.abs(A).max())
+
+
+def test_dim_reductions_keepdims(dA):
+    A, d = dA
+    for dims, axis in [(0, 0), (1, 1), ((0, 1), (0, 1))]:
+        r = dat.dsum(d, dims=dims)
+        want = A.sum(axis=axis, keepdims=True)
+        assert isinstance(r, DArray)
+        assert r.dims == want.shape
+        assert np.allclose(np.asarray(r), want, rtol=1e-4)
+
+
+def test_dim_reduction_layout_follows_grid(dA):
+    A, d = dA
+    r = dat.dsum(d, dims=1)   # reduce over the 2-chunk dim
+    # result keeps the 4-way chunking of dim 0 (mapreduce.jl:54-66)
+    assert r.pids.shape[0] == 4
+    assert np.allclose(np.asarray(r), A.sum(axis=1, keepdims=True), rtol=1e-4)
+
+
+def test_all_any_count(rng):
+    A = rng.standard_normal((30, 10)).astype(np.float32)
+    d = dat.distribute(A)
+    assert bool(dat.dall(d < 100)) is True
+    assert bool(dat.dany(d > 100)) is False
+    got = int(dat.dcount(lambda a: a > 0, d))
+    assert got == int((A > 0).sum())
+
+
+def test_extrema(dA):
+    A, d = dA
+    lo, hi = dat.dextrema(d)
+    assert np.allclose(float(lo), A.min())
+    assert np.allclose(float(hi), A.max())
+    lo_d, hi_d = dat.dextrema(d, dims=1)
+    assert np.allclose(np.asarray(lo_d), A.min(axis=1, keepdims=True))
+    assert np.allclose(np.asarray(hi_d), A.max(axis=1, keepdims=True))
+
+
+def test_map_localparts_even_shardmap(rng):
+    A = rng.standard_normal((40, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    r = dat.map_localparts(lambda lp: lp * 2.0, d)
+    assert np.allclose(np.asarray(r), A * 2, rtol=1e-6)
+
+
+def test_map_localparts_two_args(rng):
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    B = rng.standard_normal((16, 8)).astype(np.float32)
+    da = dat.distribute(A, procs=range(4), dist=(4, 1))
+    db = dat.distribute(B, procs=range(4), dist=(4, 1))
+    r = dat.map_localparts(jnp.add, da, db)
+    assert np.allclose(np.asarray(r), A + B, rtol=1e-6)
+
+
+def test_map_localparts_uneven_host_path(rng):
+    A = rng.standard_normal((50, 8)).astype(np.float32)   # uneven dim-0 cuts
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))
+    r = dat.map_localparts(lambda lp: np.asarray(lp) + 1.0, d)
+    assert np.allclose(np.asarray(r), A + 1, rtol=1e-6)
+    assert r.cuts[0] == d.cuts[0]
+
+
+def test_map_localparts_into(rng):
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))
+    dest = dat.dzeros((16, 8), procs=range(4), dist=(4, 1))
+    dat.map_localparts_into(lambda lp: lp * 3.0, dest, d)
+    assert np.allclose(np.asarray(dest), A * 3, rtol=1e-6)
+
+
+def test_samedist(rng):
+    A = rng.standard_normal((40, 24)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(8, 1))
+    like = dat.dzeros((40, 24), procs=range(8), dist=(2, 4))
+    r = dat.samedist(d, like)
+    assert r.pids.shape == (2, 4)
+    assert np.array_equal(np.asarray(r), A)
+    with pytest.raises(ValueError):
+        dat.samedist(d, dat.dzeros((3, 3)))
+
+
+def test_mapslices(rng):
+    # reference mapslices (mapreduce.jl:191-208)
+    A = rng.standard_normal((24, 16)).astype(np.float32)
+    d = dat.distribute(A)
+    r = dat.mapslices(lambda col: col / jnp.linalg.norm(col), d, dims=0)
+    want = A / np.linalg.norm(A, axis=0, keepdims=True)
+    assert np.allclose(np.asarray(r), want, rtol=1e-5)
+
+
+def test_mapslices_shape_change(rng):
+    A = rng.standard_normal((24, 16)).astype(np.float32)
+    d = dat.distribute(A)
+    r = dat.mapslices(lambda col: jnp.sum(col, keepdims=True), d, dims=0)
+    want = A.sum(axis=0, keepdims=True)
+    assert r.dims == want.shape
+    assert np.allclose(np.asarray(r), want, rtol=1e-4)
+
+
+def test_mapslices_3d_middle_dim(rng):
+    # regression: nested-vmap axis bookkeeping — slice along the MIDDLE dim
+    # of a non-square 3-D array must act on that dim, not a neighbor
+    A = rng.standard_normal((3, 5, 7)).astype(np.float32)
+    d = dat.distribute(A)
+    r = dat.mapslices(jnp.cumsum, d, dims=1)
+    want = np.cumsum(A, axis=1)
+    assert r.dims == want.shape
+    assert np.allclose(np.asarray(r), want, rtol=1e-5)
+    r2 = dat.mapslices(jnp.cumsum, d, dims=2)
+    assert np.allclose(np.asarray(r2), np.cumsum(A, axis=2), rtol=1e-5)
+
+
+def test_ppeval(rng):
+    # reference ppeval (mapreduce.jl:210-323): slicewise along the last dim
+    A = rng.standard_normal((8, 8, 4)).astype(np.float32)
+    B = rng.standard_normal((8, 8, 4)).astype(np.float32)
+    da, db = dat.distribute(A), dat.distribute(B)
+    r = dat.ppeval(jnp.matmul, da, db)
+    want = np.stack([A[:, :, k] @ B[:, :, k] for k in range(4)], axis=-1)
+    assert np.allclose(np.asarray(r), want, rtol=1e-4, atol=1e-5)
+
+
+def test_ppeval_extent_mismatch(rng):
+    da = dat.distribute(rng.standard_normal((4, 3)).astype(np.float32))
+    db = dat.distribute(rng.standard_normal((4, 5)).astype(np.float32))
+    with pytest.raises(ValueError):
+        dat.ppeval(jnp.add, da, db)
+
+
+def test_reduce_on_subdarray(rng):
+    A = rng.standard_normal((30, 30)).astype(np.float32)
+    d = dat.distribute(A)
+    v = d[5:25, 10:20]
+    assert np.allclose(float(dat.dsum(v)), A[5:25, 10:20].sum(), rtol=1e-4)
