@@ -17,7 +17,8 @@ from .core import (allowscalar, close, d_closeall, next_did, procs, registry,
 from .darray import (DArray, SubDArray, SubOrDArray, DData, darray,
                      darray_like, from_chunks, dzeros, dones, dfill, drand,
                      drandn, distribute, ddata, gather, localpart,
-                     localindices, locate, makelocal, seed)
+                     localindices, locate, makelocal, seed, copyto_, dcat,
+                     dfetch)
 from .layout import (defaultdist, defaultdist_1d, chunk_idxs, mesh_for,
                      sharding_for, nranks, all_ranks)
 from .ops.broadcast import dmap, dmap_into, djit, broadcasted
@@ -25,5 +26,10 @@ from .ops.mapreduce import (dreduce, dmapreduce, dsum, dprod, dmaximum,
                             dminimum, dmean, dstd, dvar, dall, dany, dcount,
                             dextrema, map_localparts, map_localparts_into,
                             samedist, mapslices, ppeval)
+from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
+                         rmul_diag, matmul, mul_into, dtranspose, dadjoint)
+from .ops.sort import dsort
+from .ops.sparse import dnnz, ddata_bcoo
+from . import parallel
 
 __version__ = "0.1.0"

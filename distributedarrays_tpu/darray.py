@@ -57,6 +57,9 @@ __all__ = [
     "allowscalar",
     "seed",
     "current_rank",
+    "copyto_",
+    "dcat",
+    "dfetch",
 ]
 
 
@@ -868,6 +871,50 @@ def ddata(*, init: Callable | None = None, pids: Sequence[int] | None = None,
         for p in pids:
             parts[p] = None
     return DData(parts, pids)
+
+
+def copyto_(dest, src) -> "DArray":
+    """Copy ``src`` into ``dest`` in place (reference copyto!(dest::
+    SubOrDArray, src), darray.jl:679-687: per-worker local copy of the
+    aligned view — here one XLA reshard/copy)."""
+    if isinstance(dest, SubDArray):
+        key = dest.key
+        parent = dest.parent
+        val = src.garray if isinstance(src, DArray) else (
+            src.materialize() if isinstance(src, SubDArray) else jnp.asarray(src))
+        if tuple(val.shape) != tuple(dest.shape):
+            # same contract as the DArray path / reference DimensionMismatch
+            raise ValueError(f"copyto_: src shape {tuple(val.shape)} != view "
+                             f"shape {tuple(dest.shape)}")
+        parent._rebind(parent.garray.at[tuple(key)].set(val))
+        return dest
+    if not isinstance(dest, DArray):
+        raise TypeError("copyto_ expects a DArray or SubDArray destination")
+    val = src.garray if isinstance(src, DArray) else (
+        src.materialize() if isinstance(src, SubDArray) else jnp.asarray(src))
+    if tuple(val.shape) != dest.dims:
+        raise ValueError(f"copyto_: src shape {tuple(val.shape)} != dest "
+                         f"dims {dest.dims}")
+    dest._rebind(val.astype(dest.dtype))
+    return dest
+
+
+def dcat(dim: int, *ds) -> "DArray":
+    """Concatenate distributed arrays along ``dim`` (reference hcat/vcat,
+    mapreduce.jl:18-19)."""
+    vals = [x.garray if isinstance(x, DArray) else
+            (x.materialize() if isinstance(x, SubDArray) else jnp.asarray(x))
+            for x in ds]
+    out = jnp.concatenate(vals, axis=dim)
+    first = next((x for x in ds if isinstance(x, DArray)), None)
+    procs = [int(p) for p in first.pids.flat] if first is not None else None
+    return _wrap_global(out, procs=procs)
+
+
+def dfetch(d: DArray, *i: int):
+    """Fetch one element without the scalar guard (reference Base.fetch(d,i),
+    darray.jl:386-391 — an explicit, intentional remote fetch)."""
+    return d.garray[tuple(i)]
 
 
 def gather(d):

@@ -1,0 +1,98 @@
+"""Flagship end-to-end model: a mesh-sharded MLP trained with the framework.
+
+The reference has no model layer (SURVEY.md §1: "no scheduler, no CLI, no
+model layer") — its flagship end-to-end program is "distribute → broadcast
+chain → reduction → gather".  This module provides the framework's
+equivalent *demonstrator at training scale*: an MLP whose parameters are
+tensor-parallel sharded over one mesh axis and whose batch is data-parallel
+sharded over the other, trained with a jitted step whose collectives
+(psum of partials from the tp contraction, gradient all-reduce over dp)
+are inserted by GSPMD — the pattern every DArray op in this framework
+builds on.
+
+Used by ``__graft_entry__.py`` for the single-chip compile check and the
+multi-chip dry-run.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["init_params", "forward", "loss_fn", "train_step", "make_mesh",
+           "shard_params", "shard_batch"]
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """A ('dp','tp') mesh over the first n devices (tp=2 when possible)."""
+    devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    n = len(devs)
+    tp = 2 if n % 2 == 0 and n >= 2 else 1
+    dp = n // tp
+    grid = np.asarray(devs, dtype=object).reshape(dp, tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def init_params(key, sizes: Sequence[int], dtype=jnp.bfloat16):
+    """Layer weights [in,out] + biases; bfloat16 by default to feed the MXU."""
+    params = []
+    for i in range(len(sizes) - 1):
+        key, wk = jax.random.split(key)
+        w = jax.random.normal(wk, (sizes[i], sizes[i + 1]), dtype) \
+            * jnp.asarray(np.sqrt(2.0 / sizes[i]), dtype)
+        b = jnp.zeros((sizes[i + 1],), dtype)
+        params.append({"w": w, "b": b})
+    return params
+
+
+def shard_params(params, mesh: Mesh):
+    """Tensor-parallel layout: alternate sharding the output/input feature
+    dim over the 'tp' axis (Megatron-style column→row pairs), replicated
+    over 'dp'."""
+    out = []
+    for i, layer in enumerate(params):
+        col = i % 2 == 0  # even layers: split output features; odd: input
+        wspec = P(None, "tp") if col else P("tp", None)
+        bspec = P("tp") if col else P(None)
+        out.append({
+            "w": jax.device_put(layer["w"], NamedSharding(mesh, wspec)),
+            "b": jax.device_put(layer["b"], NamedSharding(mesh, bspec)),
+        })
+    return out
+
+
+def shard_batch(x, y, mesh: Mesh):
+    sh = NamedSharding(mesh, P("dp", None))
+    return jax.device_put(x, sh), jax.device_put(y, sh)
+
+
+def forward(params, x):
+    h = x
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            h = jax.nn.gelu(h)
+    return h
+
+
+def loss_fn(params, x, y):
+    pred = forward(params, x)
+    return jnp.mean(jnp.square(pred.astype(jnp.float32) -
+                               y.astype(jnp.float32)))
+
+
+@functools.partial(jax.jit, static_argnames=("lr",), donate_argnums=(0,))
+def train_step(params, x, y, lr: float = 1e-3):
+    """One SGD step.  Params are donated so the update is in-place in HBM;
+    GSPMD inserts the tp-contraction psums and dp gradient all-reduce."""
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: (p.astype(jnp.float32) - lr * g.astype(jnp.float32))
+        .astype(p.dtype), params, grads)
+    return new_params, loss

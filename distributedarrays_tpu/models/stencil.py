@@ -1,0 +1,132 @@
+"""Halo-exchange stencil programs — the long-context / neighbor-comm demo.
+
+The reference's flagship SPMD example is Conway's Game of Life with halo
+indexing (docs/src/index.md:160-204) and the 5-point stencil pattern built
+from sendto/recvfrom rings (test/spmd.jl:84-101).  BASELINE.json config 4
+pins "spmd halo-exchange 5-point stencil on 8192×8192, sendto/recvfrom →
+lax.ppermute".
+
+TPU-native: the grid is row-sharded over a 1-D mesh; each step is ONE
+compiled shard_map program in which boundary rows ride two ``ppermute``s
+over ICI and the interior update fuses into the surrounding elementwise
+work.  Multi-step runs roll the whole iteration loop into ``lax.scan`` so
+the chain compiles once — this is exactly the communication substrate of
+ring attention / context parallelism (halo ↔ block-shift of KV blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import layout as L
+from ..darray import DArray, _wrap_global, distribute
+from ..parallel.collectives import halo_exchange
+
+__all__ = ["stencil5_step", "stencil5", "life_step", "life"]
+
+
+def _row_mesh(d: DArray):
+    pids = [int(p) for p in d.pids.flat]
+    n = len(pids)
+    if d.pids.ndim != 2 or d.pids.shape[1] != 1 or d.dims[0] % n != 0:
+        raise ValueError(
+            "stencil programs need a row-sharded even layout: "
+            f"dist=({n},1) with rows divisible; got grid {d.pids.shape} "
+            f"for dims {d.dims}")
+    return L.mesh_for(pids, (n, 1)), pids
+
+
+def _stencil_kernel(axis: str):
+    def step(block):
+        lo, hi = halo_exchange(block, axis, halo=1, dim=0, wrap=False)
+        x = jnp.concatenate([lo, block, hi], axis=0)
+        up, down = x[:-2, :], x[2:, :]
+        left = jnp.concatenate([jnp.zeros_like(block[:, :1]), block[:, :-1]],
+                               axis=1)
+        right = jnp.concatenate([block[:, 1:], jnp.zeros_like(block[:, :1])],
+                                axis=1)
+        return up + down + left + right - 4.0 * block
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _stencil_jit(mesh, iters: int):
+    axis = mesh.axis_names[0]
+    step = _stencil_kernel(axis)
+
+    def many(block):
+        def body(b, _):
+            return step(b), None
+        out, _ = lax.scan(body, block, None, length=iters)
+        return out
+
+    return jax.jit(jax.shard_map(many, mesh=mesh,
+                                 in_specs=P(axis, None),
+                                 out_specs=P(axis, None), check_vma=False))
+
+
+def stencil5_step(d: DArray) -> DArray:
+    """One 5-point Laplacian step with zero boundary (reference pattern,
+    docs/src/index.md:160-181)."""
+    return stencil5(d, iters=1)
+
+
+def stencil5(d: DArray, iters: int = 1) -> DArray:
+    """``iters`` Laplacian steps compiled as one program (lax.scan over the
+    halo-exchange step; communication = 2 ppermutes/step over ICI)."""
+    mesh, pids = _row_mesh(d)
+    res = _stencil_jit(mesh, int(iters))(d.garray)
+    return _wrap_global(res, procs=pids, dist=list(d.pids.shape))
+
+
+# ---------------------------------------------------------------------------
+# Game of Life (reference docs/src/index.md:160-204)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _life_jit(mesh, iters: int):
+    axis = mesh.axis_names[0]
+
+    def step(block):
+        lo, hi = halo_exchange(block, axis, halo=1, dim=0, wrap=False)
+        x = jnp.concatenate([lo, block, hi], axis=0)
+        xp = jnp.pad(x, ((0, 0), (1, 1)))
+        # 3x3 neighbor sums for the m center rows (halo rows drop out of the
+        # row slices; column pad handles the lateral boundary)
+        neigh = (xp[:-2, :-2] + xp[:-2, 1:-1] + xp[:-2, 2:] +
+                 xp[1:-1, :-2] + xp[1:-1, 2:] +
+                 xp[2:, :-2] + xp[2:, 1:-1] + xp[2:, 2:])
+        alive = x[1:-1, :]
+        born = (alive == 0) & (neigh == 3)
+        survive = (alive == 1) & ((neigh == 2) | (neigh == 3))
+        return jnp.where(born | survive, 1, 0).astype(block.dtype)
+
+    def many(block):
+        def body(b, _):
+            return step(b), None
+        out, _ = lax.scan(body, block, None, length=iters)
+        return out
+
+    return jax.jit(jax.shard_map(many, mesh=mesh,
+                                 in_specs=P(axis, None),
+                                 out_specs=P(axis, None), check_vma=False))
+
+
+def life_step(d: DArray) -> DArray:
+    return life(d, iters=1)
+
+
+def life(d: DArray, iters: int = 1) -> DArray:
+    """Conway's Game of Life with zero (dead) boundary, the reference's
+    distributed demo (docs/src/index.md:160-204)."""
+    mesh, pids = _row_mesh(d)
+    res = _life_jit(mesh, int(iters))(d.garray)
+    return _wrap_global(res, procs=pids, dist=list(d.pids.shape))

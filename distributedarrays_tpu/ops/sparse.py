@@ -1,0 +1,64 @@
+"""Sparse extension parity.
+
+Reference: ext/SparseArraysExt.jl (31 LoC) — ``nnz(A::DArray)`` is the sum
+of per-worker ``nnz(localpart)`` (SparseArraysExt.jl:7-12).  JAX's sparse
+story is ``jax.experimental.sparse.BCOO``; a dense sharded array's "nnz" is
+a jitted count-nonzero (one local count per device + psum, same two-phase
+shape as the reference).
+
+``ddata_bcoo``/``dnnz`` also support the host-object route: a DData whose
+per-rank parts are BCOO matrices, mirroring the reference's
+sparse-localpart DArrays built via ``DArray(I->sprandn(...))``
+(test/darray.jl sparse sections).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..darray import DArray, DData, SubDArray
+from .broadcast import _unwrap
+
+try:  # pragma: no cover - availability probe
+    from jax.experimental import sparse as jsparse
+except Exception:  # pragma: no cover
+    jsparse = None
+
+__all__ = ["dnnz", "ddata_bcoo"]
+
+
+@functools.lru_cache(maxsize=None)
+def _nnz_jit():
+    return jax.jit(lambda a: jnp.sum(a != 0))
+
+
+def dnnz(d) -> int:
+    """Number of stored/nonzero entries (reference nnz,
+    SparseArraysExt.jl:7-12)."""
+    if isinstance(d, DData):
+        total = 0
+        for part in d.gather():
+            if jsparse is not None and isinstance(part, jsparse.BCOO):
+                total += int(part.nse)
+            else:
+                total += int(np.count_nonzero(np.asarray(part)))
+        return total
+    if jsparse is not None and isinstance(d, jsparse.BCOO):
+        return int(d.nse)
+    return int(_nnz_jit()(_unwrap(d)))
+
+
+def ddata_bcoo(d: DArray) -> DData:
+    """Convert each rank's chunk to a BCOO sparse matrix held in a DData
+    (host-object sharded container for non-dense localparts; SURVEY.md §7
+    'heterogeneous local types')."""
+    if jsparse is None:  # pragma: no cover
+        raise RuntimeError("jax.experimental.sparse unavailable")
+    pids = [int(p) for p in d.pids.flat]
+    parts = {p: jsparse.BCOO.fromdense(d.localpart(p)) for p in pids}
+    return DData(parts, pids)

@@ -1,0 +1,7 @@
+from . import collectives, spmd_mode  # noqa: F401
+from .collectives import (axis_rank, axis_size, halo_exchange, pall_to_all,
+                          pbarrier, pbcast, pgather, preduce, pshift,
+                          run_spmd, spmd_mesh)
+from .spmd_mode import (SPMDContext, barrier, bcast, close_context, context,
+                   context_local_storage, gather_spmd, myid, nprocs,
+                   recvfrom, recvfrom_any, scatter, sendto, spmd)

@@ -1,0 +1,350 @@
+"""MPI-style SPMD programming mode.
+
+TPU-native counterpart of /root/reference/src/spmd.jl (260 LoC).  The
+reference gives each worker a RemoteChannel, a demux task routing
+``(ctxt_id, typ, from, data, tag)`` tuples into per-context channels
+(spmd.jl:72-98), out-of-order buffering for unexpected messages
+(spmd.jl:126-143), and collectives built from send/recv (159-231).
+
+Design split for TPU:
+
+- **This module** is the *dynamic* half: fully general tagged send/recv
+  between ranks, contexts with context-local storage, barrier/bcast/
+  scatter/gather — runs host-side, one Python task (thread) per rank under
+  the single controller.  Message passing is in-memory mailbox matching,
+  which preserves the reference's semantics (tags, out-of-order buffering,
+  any pattern, any payload) exactly — there is no TCP to emulate.
+- ``parallel.collectives`` is the *static* half: communication patterns
+  known at trace time (ring shifts, halo exchange, all-to-all) compile to
+  ``shard_map`` + ``lax.ppermute``/``psum``/``all_to_all`` over ICI — that
+  is the path where the reference's send/recv ring programs (e.g.
+  test/spmd.jl:90-101, the stencil in docs/src/index.md:160-181) belong on
+  TPU, and what the benchmarks exercise.
+
+Inside ``spmd(f, ...)`` each rank task sees ``myid()`` (its rank) and
+DArray ``localpart`` resolves against that rank, mirroring how reference
+SPMD closures address their chunk.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .. import core
+from .. import layout as L
+
+__all__ = [
+    "spmd", "sendto", "recvfrom", "recvfrom_any", "barrier", "bcast",
+    "scatter", "gather_spmd", "context", "context_local_storage", "myid",
+    "nprocs", "SPMDContext", "close_context",
+]
+
+_DEFAULT_TIMEOUT = 60.0  # seconds; a stuck collective fails loudly, not forever
+
+
+class _Mailbox:
+    """Per-(context, rank) message store with tag/type/source matching and
+    out-of-order buffering (reference spmd.jl:126-143: unexpected messages
+    are stashed and re-examined)."""
+
+    def __init__(self):
+        self._msgs: list[tuple] = []          # (typ, from_pid, data, tag)
+        self._cond = threading.Condition()
+
+    def put(self, msg: tuple):
+        with self._cond:
+            self._msgs.append(msg)
+            self._cond.notify_all()
+
+    def take(self, match: Callable[[tuple], bool], failed: "threading.Event",
+             timeout: float):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                for i, m in enumerate(self._msgs):
+                    if match(m):
+                        return self._msgs.pop(i)
+                if failed.is_set():
+                    raise RuntimeError("SPMD peer task failed; aborting receive")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"SPMD receive timed out after {timeout}s "
+                        f"(pending: {[(m[0], m[1], m[3]) for m in self._msgs[:8]]})")
+                self._cond.wait(min(remaining, 0.1))
+
+
+class SPMDContext:
+    """Execution context: isolates message traffic and carries per-rank local
+    storage (reference SPMDContext, spmd.jl:18-35; storage spmd.jl:59-64)."""
+
+    def __init__(self, pids: Sequence[int] | None = None):
+        self.id = core.next_did()
+        self.pids = [int(p) for p in (pids if pids is not None else L.all_ranks())]
+        self.store: dict[int, dict] = {p: {} for p in self.pids}
+        self._mailboxes: dict[int, _Mailbox] = {p: _Mailbox() for p in self.pids}
+        self._barrier_gen: dict[int, int] = {p: 0 for p in self.pids}
+        self._failed = threading.Event()
+        self._release_gen = 0
+
+    def mailbox(self, pid: int) -> _Mailbox:
+        try:
+            return self._mailboxes[pid]
+        except KeyError:
+            raise ValueError(f"rank {pid} is not in context {self.id} "
+                             f"(pids={self.pids})") from None
+
+    def close(self):
+        """Free message state (reference delete_ctxt_id broadcast,
+        spmd.jl:30-35,256-258)."""
+        self._mailboxes = {p: _Mailbox() for p in self.pids}
+        self.store = {p: {} for p in self.pids}
+
+    def _reset_comm(self):
+        """Drain in-flight messages and resynchronize barrier generations
+        after a failed run, keeping per-rank storage.  Without this an
+        explicit context is poisoned: stale messages satisfy future
+        receives and diverged barrier generations deadlock the next run."""
+        self._mailboxes = {p: _Mailbox() for p in self.pids}
+        self._barrier_gen = {p: 0 for p in self.pids}
+        self._failed = threading.Event()
+
+
+_CONTEXTS_LOCK = threading.Lock()
+_CONTEXTS: dict = {}
+
+_tls = threading.local()
+
+
+def context(pids: Sequence[int] | None = None) -> SPMDContext:
+    """Create an explicit SPMD context (reference context(), spmd.jl:59-61)."""
+    c = SPMDContext(pids)
+    with _CONTEXTS_LOCK:
+        _CONTEXTS[c.id] = c
+    return c
+
+
+def close_context(c: SPMDContext):
+    with _CONTEXTS_LOCK:
+        _CONTEXTS.pop(c.id, None)
+    c.close()
+
+
+def _current() -> tuple[SPMDContext, int]:
+    ctx = getattr(_tls, "ctxt", None)
+    if ctx is None:
+        raise RuntimeError(
+            "not inside an spmd() run — sendto/recvfrom/barrier/... are only "
+            "meaningful within spmd(f, ...) (reference spmd.jl:118)")
+    return ctx, core.current_rank()
+
+
+def myid() -> int:
+    """Rank of the calling SPMD task (reference myid())."""
+    return core.current_rank()
+
+
+def nprocs() -> int:
+    ctx = getattr(_tls, "ctxt", None)
+    return len(ctx.pids) if ctx is not None else L.nranks()
+
+
+def context_local_storage() -> dict:
+    """This rank's per-context dict, persistent across spmd() runs on the
+    same explicit context (reference context_local_storage, spmd.jl:62-64)."""
+    ctx, rank = _current()
+    return ctx.store[rank]
+
+
+# ---------------------------------------------------------------------------
+# point-to-point
+# ---------------------------------------------------------------------------
+
+
+def sendto(pid: int, data: Any, tag: Any = None):
+    """Async send to ``pid`` (reference sendto, spmd.jl:145-147)."""
+    ctx, rank = _current()
+    ctx.mailbox(pid).put(("sendto", rank, data, tag))
+
+
+def recvfrom(pid: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+    """Blocking receive of a message from ``pid`` with matching ``tag``
+    (reference recvfrom, spmd.jl:149-151).  Out-of-order messages stay
+    buffered until their matching receive."""
+    ctx, rank = _current()
+    m = ctx.mailbox(rank).take(
+        lambda m: m[0] == "sendto" and m[1] == pid and m[3] == tag,
+        ctx._failed, timeout)
+    return m[2]
+
+
+def recvfrom_any(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+    """Receive from whichever rank sends first; returns ``(from_pid, data)``
+    (reference recvfrom_any, spmd.jl:153-157)."""
+    ctx, rank = _current()
+    m = ctx.mailbox(rank).take(
+        lambda m: m[0] == "sendto" and m[3] == tag, ctx._failed, timeout)
+    return m[1], m[2]
+
+
+# ---------------------------------------------------------------------------
+# collectives (reference spmd.jl:159-231)
+# ---------------------------------------------------------------------------
+
+
+def barrier(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+    """All-to-all barrier with double-barrier protection via per-rank
+    generation counters (reference barrier, spmd.jl:159-184)."""
+    ctx, rank = _current()
+    gen = ctx._barrier_gen[rank]
+    ctx._barrier_gen[rank] = gen + 1
+    btag = ("barrier", gen, tag)
+    for p in ctx.pids:
+        ctx.mailbox(p).put(("barrier", rank, None, btag))
+    for p in ctx.pids:
+        ctx.mailbox(rank).take(
+            lambda m, p=p: m[0] == "barrier" and m[1] == p and m[3] == btag,
+            ctx._failed, timeout)
+
+
+def _check_root(ctx, root):
+    if root not in ctx.pids:
+        raise ValueError(f"root {root} is not in context pids {ctx.pids}")
+
+
+def bcast(data: Any, root: int, tag: Any = None,
+          timeout: float = _DEFAULT_TIMEOUT):
+    """Broadcast from ``root`` to every rank (reference bcast,
+    spmd.jl:186-196)."""
+    ctx, rank = _current()
+    _check_root(ctx, root)
+    btag = ("bcast", tag)
+    if rank == root:
+        for p in ctx.pids:
+            if p != root:
+                ctx.mailbox(p).put(("sendto", root, data, btag))
+        return data
+    m = ctx.mailbox(rank).take(
+        lambda m: m[0] == "sendto" and m[1] == root and m[3] == btag,
+        ctx._failed, timeout)
+    return m[2]
+
+
+def scatter(x, root: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+    """Split ``x`` evenly across ranks from ``root`` (reference scatter,
+    spmd.jl:198-212; equal division is asserted like the reference's
+    ``@assert rem(length(x), length(pids)) == 0``)."""
+    ctx, rank = _current()
+    _check_root(ctx, root)
+    stag = ("scatter", tag)
+    if rank == root:
+        n = len(x)
+        if n % len(ctx.pids) != 0:
+            raise ValueError(
+                f"scatter: length {n} not divisible by {len(ctx.pids)} ranks")
+        per = n // len(ctx.pids)
+        mine = None
+        for i, p in enumerate(ctx.pids):
+            part = x[i * per:(i + 1) * per]
+            if p == rank:
+                mine = part
+            else:
+                ctx.mailbox(p).put(("sendto", root, part, stag))
+        return mine
+    m = ctx.mailbox(rank).take(
+        lambda m: m[0] == "sendto" and m[1] == root and m[3] == stag,
+        ctx._failed, timeout)
+    return m[2]
+
+
+def gather_spmd(x, root: int, tag: Any = None,
+                timeout: float = _DEFAULT_TIMEOUT):
+    """Collect one value per rank at ``root``, pid-ordered (reference gather,
+    spmd.jl:214-231).  Returns the list on root, None elsewhere."""
+    ctx, rank = _current()
+    _check_root(ctx, root)
+    gtag = ("gather", tag)
+    if rank != root:
+        ctx.mailbox(root).put(("sendto", rank, x, gtag))
+        return None
+    out = {}
+    out[rank] = x
+    for p in ctx.pids:
+        if p == root:
+            continue
+        m = ctx.mailbox(rank).take(
+            lambda m, p=p: m[0] == "sendto" and m[1] == p and m[3] == gtag,
+            ctx._failed, timeout)
+        out[p] = m[2]
+    return [out[p] for p in ctx.pids]
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
+         context: SPMDContext | None = None, timeout: float = 300.0):
+    """Run ``f(*args)`` once per rank, concurrently (reference spmd driver,
+    spmd.jl:233-254).
+
+    Each rank runs in its own task with ``myid()`` set, an implicit fresh
+    context unless an explicit one is passed (implicit contexts are cleared
+    after the run, like the reference's ``clear_ctxt`` path), and DArray
+    arguments resolve ``localpart()`` against the task's rank.  Returns the
+    per-rank return values, pid-ordered.
+    """
+    implicit = context is None
+    ctx = SPMDContext(pids) if implicit else context
+    if pids is not None and not implicit and list(pids) != ctx.pids:
+        raise ValueError("pids disagree with explicit context's pids")
+    results: dict[int, Any] = {}
+    errors: dict[int, BaseException] = {}
+
+    def run(rank: int):
+        core._rank_tls.rank = rank
+        _tls.ctxt = ctx
+        try:
+            results[rank] = f(*args)
+        except BaseException as e:  # noqa: BLE001 — propagated to caller
+            errors[rank] = e
+            ctx._failed.set()
+        finally:
+            core._rank_tls.rank = 0
+            _tls.ctxt = None
+
+    threads = [threading.Thread(target=run, args=(p,), name=f"spmd-{p}",
+                                daemon=True) for p in ctx.pids]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join(timeout)
+            if t.is_alive():
+                ctx._failed.set()      # wake blocked receivers
+                for t2 in threads:
+                    t2.join(5)
+                raise TimeoutError(
+                    f"spmd task {t.name} did not finish in {timeout}s")
+    finally:
+        if implicit:
+            ctx.close()
+        elif errors or any(t.is_alive() for t in threads):
+            # failed or timed-out run: drain stale messages and resync
+            # barrier generations so the explicit context stays usable
+            ctx._reset_comm()
+    if errors:
+        # prefer the root-cause failure over secondary "peer failed" aborts
+        primary = [(r, e) for r, e in sorted(errors.items())
+                   if not (isinstance(e, RuntimeError)
+                           and "peer task failed" in str(e))]
+        rank, err = primary[0] if primary else sorted(errors.items())[0]
+        raise RuntimeError(
+            f"spmd task on rank {rank} failed ({len(errors)} total failures)"
+        ) from err
+    return [results[p] for p in ctx.pids]

@@ -273,6 +273,38 @@ def test_darray_like(rng):
     assert np.allclose(np.asarray(e), 1.0)
 
 
+def test_copyto(rng):
+    A = rng.standard_normal((16, 16)).astype(np.float32)
+    B = rng.standard_normal((16, 16)).astype(np.float32)
+    da = dat.distribute(A.copy())
+    dat.copyto_(da, dat.distribute(B))
+    assert np.array_equal(np.asarray(da), B)
+    # into a view region (reference copyto!(::SubDArray, src))
+    dat.copyto_(da[0:4, 0:4], np.zeros((4, 4), np.float32))
+    B2 = B.copy(); B2[0:4, 0:4] = 0
+    assert np.array_equal(np.asarray(da), B2)
+    with pytest.raises(ValueError):
+        dat.copyto_(da, np.zeros((3, 3), np.float32))
+
+
+def test_dcat(rng):
+    A = rng.standard_normal((8, 4)).astype(np.float32)
+    B = rng.standard_normal((8, 4)).astype(np.float32)
+    da, db = dat.distribute(A), dat.distribute(B)
+    v = dat.dcat(0, da, db)       # vcat
+    assert v.dims == (16, 4)
+    assert np.array_equal(np.asarray(v), np.concatenate([A, B], 0))
+    h = dat.dcat(1, da, B)        # hcat with a plain array
+    assert h.dims == (8, 8)
+    assert np.array_equal(np.asarray(h), np.concatenate([A, B], 1))
+
+
+def test_dfetch():
+    d = dat.dfill(3.5, (4, 4))
+    # explicit fetch bypasses the scalar guard (reference Base.fetch)
+    assert float(dat.dfetch(d, 2, 2)) == 3.5
+
+
 def test_iteration_guarded():
     d = dat.dzeros((4,))
     with pytest.raises(RuntimeError):

@@ -1,0 +1,34 @@
+"""Extension parity tests (reference ext/SparseArraysExt.jl,
+ext/StatisticsExt.jl)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+
+
+def test_dnnz_dense(rng):
+    A = rng.standard_normal((32, 32)).astype(np.float32)
+    A[A < 0.5] = 0
+    d = dat.distribute(A)
+    assert dat.dnnz(d) == int(np.count_nonzero(A))
+
+
+def test_dnnz_bcoo(rng):
+    A = rng.standard_normal((16, 16)).astype(np.float32)
+    A[np.abs(A) < 1.0] = 0
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))
+    dd = dat.ddata_bcoo(d)
+    assert dat.dnnz(dd) == int(np.count_nonzero(A))
+
+
+def test_mean_std_parity(rng):
+    # reference StatisticsExt: mean(d; dims) = sum/prod(size) (:6)
+    A = rng.standard_normal((64, 32)).astype(np.float32)
+    d = dat.distribute(A)
+    assert np.allclose(float(dat.dmean(d)), A.mean(), rtol=1e-5)
+    m = dat.dmean(d, dims=0)
+    assert np.allclose(np.asarray(m), A.mean(axis=0, keepdims=True), rtol=1e-4)
+    assert np.allclose(float(dat.dstd(d)), A.std(ddof=1), rtol=1e-4)

@@ -1,0 +1,55 @@
+"""Ring attention tests: exactness vs a dense O(seq²) oracle, causal and
+full, across all 8 sequence shards (the long-context deliverable of
+SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.models import ring_attention as RA
+
+
+@pytest.fixture
+def qkv(rng):
+    S, H, D = 64, 4, 16
+    mk = lambda: rng.standard_normal((S, H, D)).astype(np.float32)
+    q, k, v = mk(), mk(), mk()
+    dist = (8, 1, 1)
+    return (q, k, v,
+            dat.distribute(q, procs=range(8), dist=dist),
+            dat.distribute(k, procs=range(8), dist=dist),
+            dat.distribute(v, procs=range(8), dist=dist))
+
+
+def test_full_attention_exact(qkv):
+    q, k, v, dq, dk, dv = qkv
+    got = np.asarray(RA.ring_attention(dq, dk, dv))
+    want = RA.reference_attention(q, k, v)
+    assert got.shape == want.shape
+    assert np.abs(got - want).max() < 1e-5
+
+
+def test_causal_attention_exact(qkv):
+    q, k, v, dq, dk, dv = qkv
+    got = np.asarray(RA.ring_attention(dq, dk, dv, causal=True))
+    want = RA.reference_attention(q, k, v, causal=True)
+    assert np.abs(got - want).max() < 1e-5
+    # the first row attends only to itself
+    sm = v[0] / 1.0
+    assert np.allclose(got[0], sm, rtol=1e-5)
+
+
+def test_result_stays_sequence_sharded(qkv):
+    *_, dq, dk, dv = qkv
+    out = RA.ring_attention(dq, dk, dv)
+    assert out.pids.shape == (8, 1, 1)
+    assert out.cuts[0] == dq.cuts[0]
+
+
+def test_shape_validation(qkv):
+    _, _, _, dq, dk, _ = qkv
+    with pytest.raises(ValueError, match="dims must match"):
+        RA.ring_attention(dq, dk, dat.dzeros((64, 4, 8)))
+    with pytest.raises(ValueError, match="must be"):
+        RA.ring_attention(dat.dzeros((8, 8)), dat.dzeros((8, 8)),
+                          dat.dzeros((8, 8)))

@@ -1,0 +1,76 @@
+"""Distributed sort tests (reference test/darray.jl:1015-1025: sort vs
+Base.sort for all sample strategies)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.ops.sort import dsort
+
+
+def test_psrs_matches_numpy(rng):
+    x = rng.standard_normal(4096).astype(np.float32)
+    d = dat.distribute(x)
+    s = dsort(d, alg="psrs")
+    assert np.array_equal(np.asarray(s), np.sort(x))
+    # total length preserved, chunks tile it (layout may be uneven)
+    assert s.dims == (4096,)
+
+
+def test_psrs_result_distribution_changes(rng):
+    # skewed data → uneven result chunks, like the reference's rebuilt
+    # distribution (sort.jl:164-169)
+    x = np.concatenate([np.zeros(3000, np.float32),
+                        rng.standard_normal(1096).astype(np.float32)])
+    rng.shuffle(x)
+    d = dat.distribute(x)
+    s = dsort(d, alg="psrs")
+    assert np.array_equal(np.asarray(s), np.sort(x))
+
+
+def test_sort_rev(rng):
+    x = rng.standard_normal(1024).astype(np.float32)
+    s = dsort(dat.distribute(x), rev=True)
+    assert np.array_equal(np.asarray(s), np.sort(x)[::-1])
+
+
+def test_sort_by_key(rng):
+    x = rng.standard_normal(512).astype(np.float32)
+    s = dsort(dat.distribute(x), by=jnp.abs)
+    want = x[np.argsort(np.abs(x), kind="stable")]
+    assert np.array_equal(np.asarray(s), want)
+
+
+def test_sort_int_dtype(rng):
+    x = rng.integers(-1000, 1000, size=2048).astype(np.int32)
+    s = dsort(dat.distribute(x), alg="psrs")
+    assert np.array_equal(np.asarray(s), np.sort(x))
+
+
+def test_sort_uneven_length_fallback(rng):
+    # length not divisible by ranks → global path, still correct
+    x = rng.standard_normal(1001).astype(np.float32)
+    s = dsort(dat.distribute(x))
+    assert np.array_equal(np.asarray(s), np.sort(x))
+
+
+def test_sort_sample_kwarg_parity(rng):
+    # reference accepts sample=true|false|(min,max)|Array (sort.jl:110-135)
+    x = rng.standard_normal(512).astype(np.float32)
+    d = dat.distribute(x)
+    for sample in [True, False, (-3.0, 3.0)]:
+        s = dsort(d, sample=sample)
+        assert np.array_equal(np.asarray(s), np.sort(x))
+
+
+def test_sort_2d_raises(rng):
+    with pytest.raises(ValueError):
+        dsort(dat.dzeros((4, 4)))
+
+
+def test_psrs_ineligible_raises(rng):
+    x = rng.standard_normal(1001).astype(np.float32)
+    with pytest.raises(ValueError):
+        dsort(dat.distribute(x), alg="psrs")
