@@ -1,0 +1,92 @@
+"""Multi-host (DCN) support: the distributed communication backend's
+cross-host half.
+
+The reference scales by adding Julia worker processes over TCP
+(`addprocs`, Distributed stdlib — SURVEY.md §2 "Distributed communication
+backend").  The TPU-native equivalent is one JAX *controller per host*
+coordinating through ``jax.distributed``: inside a jitted program,
+cross-host communication is the same XLA collectives as cross-chip — they
+ride ICI within a slice and DCN across slices, chosen by the compiler from
+the mesh topology.  Nothing else in this framework changes for multi-host:
+every op is expressed against a ``Mesh``, so a mesh built from global
+devices makes DArrays span hosts.
+
+On a single-host environment these helpers degrade gracefully (process
+count 1), so the same program runs everywhere — the multi-host analog of
+the reference running its full test suite on local `addprocs` workers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .. import layout as L
+
+__all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
+           "host_local_slice"]
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the multi-host job (wraps ``jax.distributed.initialize``).
+
+    With no arguments, attempts the standard auto-detecting initialization
+    (TPU pod metadata / cluster env); if no cluster is detected the call
+    degrades to a single-process no-op, so the same program runs on a
+    laptop and a pod.  After joining, ``jax.devices()`` is the *global*
+    device list and meshes built from it span hosts.
+    """
+    if num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        return
+    try:
+        jax.distributed.initialize()
+    except Exception:
+        # no cluster environment detected: single-process mode
+        pass
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def global_mesh(shape: tuple[int, ...], axis_names: tuple[str, ...]) -> Mesh:
+    """Mesh over ALL global devices.  Axis order should put the
+    fastest-communicating axes (tensor/sequence parallel) within a host's
+    slice so their collectives ride ICI, and the slowest (data parallel)
+    across hosts on DCN — the scaling-book layout recipe."""
+    devs = np.asarray(jax.devices(), dtype=object)
+    if int(np.prod(shape)) != devs.size:
+        raise ValueError(f"mesh shape {shape} != {devs.size} global devices")
+    return Mesh(devs.reshape(shape), axis_names)
+
+
+def sync_hosts(name: str = "sync") -> None:
+    """Barrier across controller processes (host-side, for program phases;
+    in-program synchronization is a collective, not this)."""
+    if jax.process_count() > 1:  # pragma: no cover - needs real multi-host
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def host_local_slice(d) -> list:
+    """The chunks of ``d`` owned by this host's local devices (the
+    multi-controller analog of ``localpart``)."""
+    local = {dev.id for dev in jax.local_devices()}
+    out = []
+    for pid in [int(p) for p in d.pids.flat]:
+        dev = jax.devices()[pid]
+        if dev.id in local:
+            out.append((pid, d.localpart(pid)))
+    return out

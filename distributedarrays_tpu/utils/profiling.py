@@ -1,0 +1,68 @@
+"""Tracing / profiling utilities.
+
+The reference has no tracing subsystem (SURVEY.md §5: "Tracing/profiling:
+none — only commented-out println debugging", spmd.jl:122,136).  On TPU we
+get a real profiler from the platform; this module wraps it in the
+framework's terms:
+
+- ``trace(dir)`` — context manager capturing a JAX/XLA profile (viewable
+  in Perfetto / TensorBoard) around any block of DArray operations.
+- ``annotate(name)`` — named trace spans for host-side phases.
+- ``op_timer()`` — lightweight wall-clock accounting of eager ops with
+  marginal-cost support (see bench.py for the tunnel caveat).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["trace", "annotate", "OpTimer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a JAX profiler trace of the enclosed block.
+
+    View with `tensorboard --logdir <dir>` or ui.perfetto.dev.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span that shows up on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class OpTimer:
+    """Accumulating wall-clock timer for host-side phases.
+
+    >>> t = OpTimer()
+    >>> with t("distribute"): d = distribute(A)
+    >>> t.report()
+    """
+
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> dict:
+        return {k: {"total_s": self.totals[k], "calls": self.counts[k],
+                    "mean_s": self.totals[k] / self.counts[k]}
+                for k in sorted(self.totals)}

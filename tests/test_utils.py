@@ -1,0 +1,127 @@
+"""Aux-subsystem tests: checkpoint/resume, profiling, multihost helpers,
+Pallas GEMM kernel (interpret mode on the CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.ops.pallas_gemm import pallas_matmul
+from distributedarrays_tpu.parallel import multihost
+from distributedarrays_tpu.utils import checkpoint, profiling
+
+
+def test_checkpoint_roundtrip_darray(tmp_path, rng):
+    A = rng.standard_normal((50, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    state = {"step": 7, "d": d, "lr": 1e-3, "name": "run1",
+             "w": jnp.ones((4,)), "hist": [1, 2, (3, 4)]}
+    checkpoint.save(tmp_path / "ckpt", state)
+    d.close()
+    back = checkpoint.load(tmp_path / "ckpt")
+    assert back["step"] == 7 and back["name"] == "run1"
+    assert isinstance(back["d"], dat.DArray)
+    assert back["d"].pids.shape == (4, 2)
+    assert back["d"].cuts[0] == [0, 13, 26, 38, 50]
+    assert np.array_equal(np.asarray(back["d"]), A)
+    assert isinstance(back["w"], jax.Array)
+    assert back["hist"] == [1, 2, (3, 4)]
+
+
+def test_checkpoint_ddata(tmp_path):
+    dd = dat.ddata(data=list(range(8)))
+    checkpoint.save(tmp_path / "c2", {"dd": dd})
+    back = checkpoint.load(tmp_path / "c2")
+    assert dat.gather(back["dd"]) == list(range(8))
+
+
+def test_checkpoint_preserves_nondefault_cuts(tmp_path):
+    # regression: a from_chunks layout with non-default cuts must restore
+    # with exactly those cuts, not the recomputed default
+    chunks = np.empty((2,), dtype=object)
+    chunks[0] = np.ones((3,), np.float32)
+    chunks[1] = np.full((29,), 2.0, np.float32)
+    d = dat.from_chunks(chunks)
+    assert d.cuts[0] == [0, 3, 32]
+    checkpoint.save(tmp_path / "c4", d)
+    back = checkpoint.load(tmp_path / "c4")
+    assert back.cuts[0] == [0, 3, 32]
+    assert np.array_equal(np.asarray(back), np.asarray(d))
+
+
+def test_checkpoint_preserves_keys_and_scalar_types(tmp_path):
+    state = {"table": {3: "x", (1, 2): "y"}, "step": np.int64(7),
+             "flag": np.bool_(True)}
+    checkpoint.save(tmp_path / "c5", state)
+    back = checkpoint.load(tmp_path / "c5")
+    assert back["table"][3] == "x" and back["table"][(1, 2)] == "y"
+    assert back["step"] == 7 and back["step"].dtype == np.int64
+    assert back["flag"].dtype == np.bool_
+
+
+def test_checkpoint_rejects_unknown_leaf(tmp_path):
+    with pytest.raises(TypeError):
+        checkpoint.save(tmp_path / "c3", {"f": open})
+
+
+def test_op_timer():
+    t = profiling.OpTimer()
+    with t("phase"):
+        _ = float(dat.dsum(dat.dones((64, 64))))
+    with t("phase"):
+        pass
+    rep = t.report()
+    assert rep["phase"]["calls"] == 2
+    assert rep["phase"]["total_s"] > 0
+
+
+def test_trace_annotation_smoke(tmp_path):
+    with profiling.annotate("span"):
+        _ = float(dat.dsum(dat.dones((8, 8))))
+
+
+def test_multihost_single_process():
+    info = multihost.process_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8
+    mesh = multihost.global_mesh((4, 2), ("dp", "tp"))
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        multihost.global_mesh((3, 2), ("a", "b"))
+    multihost.sync_hosts()   # no-op single process
+    multihost.initialize()   # no-op single process
+
+
+def test_host_local_slice(rng):
+    A = rng.standard_normal((32, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))
+    parts = multihost.host_local_slice(d)
+    assert [p for p, _ in parts] == [0, 1, 2, 3]
+    assert np.array_equal(np.asarray(parts[2][1]), A[16:24])
+
+
+def test_pallas_matmul_interpret(rng):
+    a = rng.standard_normal((256, 128)).astype(np.float32)
+    b = rng.standard_normal((128, 256)).astype(np.float32)
+    c = np.asarray(pallas_matmul(a, b, block=(128, 128, 128)))
+    assert np.allclose(c, a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_matmul_fused_epilogue(rng):
+    a = rng.standard_normal((128, 128)).astype(np.float32)
+    b = rng.standard_normal((128, 128)).astype(np.float32)
+    c = np.asarray(pallas_matmul(a, b, block=(128, 128, 128),
+                                 epilogue=jax.nn.gelu))
+    want = np.asarray(jax.nn.gelu(jnp.asarray(a @ b)))
+    assert np.allclose(c, want, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_matmul_validation(rng):
+    a = rng.standard_normal((100, 64)).astype(np.float32)
+    b = rng.standard_normal((64, 64)).astype(np.float32)
+    with pytest.raises(ValueError, match="divide"):
+        pallas_matmul(a, b, block=(64, 64, 64))
+    with pytest.raises(ValueError, match="mismatch"):
+        pallas_matmul(b, a)
