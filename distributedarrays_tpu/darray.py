@@ -343,7 +343,12 @@ class DArray:
         if new_data.shape != tuple(self.dims):
             raise ValueError("rebind shape mismatch")
         if new_data.sharding != self._sharding:
-            new_data = _resharder(self._sharding)(new_data)
+            if new_data.size == 0:
+                # XLA rejects out_shardings on zero-element results;
+                # device_put places them fine
+                new_data = jax.device_put(new_data, self._sharding)
+            else:
+                new_data = _resharder(self._sharding)(new_data)
         self._data = new_data
 
     def with_data(self, new_data: jax.Array, did=None) -> "DArray":
@@ -391,6 +396,23 @@ class DArray:
     def copy(self) -> "DArray":
         """Independent copy with the same layout (darray.jl:689-697)."""
         return self.with_data(jnp.copy(self.garray))
+
+    def __deepcopy__(self, memo):
+        c = memo.get(id(self))
+        if c is None:
+            memo[id(self)] = c = self.copy()
+        return c
+
+    def similar(self, dtype=None, dims=None) -> "DArray":
+        """Uninitialized-alike array (reference similar, darray.jl:238-241):
+        same layout when dims match, default layout otherwise."""
+        dtype = self.dtype if dtype is None else dtype
+        if dims is None or tuple(dims) == self.dims:
+            return self.with_data(
+                _filler("fill", self.dims, np.dtype(dtype), self._sharding)(
+                    jnp.zeros((), dtype)))
+        return dzeros(tuple(dims), dtype=dtype,
+                      procs=[int(p) for p in self.pids.flat])
 
     def __eq__(self, other):
         # whole-array equality, like the reference's Base.== (darray.jl:403-441)

