@@ -1,0 +1,140 @@
+"""Expert parallelism: top-1 mixture-of-experts with all_to_all dispatch.
+
+Completes the parallelism inventory (SURVEY.md §2: "EP absent in
+reference — all-to-all covers the communication substrate it needs").  The
+substrate is exactly the reference's sample-sort scatter (sort.jl:24-55):
+bucketize locally, exchange buckets all-to-all, process, exchange back.
+Here the buckets are tokens routed to experts, the exchange is
+``lax.all_to_all`` over the ``ep`` mesh axis, and the whole
+route→dispatch→FFN→return→combine path is ONE compiled shard_map program.
+
+Top-1 routing with a capacity limit: each rank sends at most ``capacity``
+tokens to each expert; overflowing tokens pass through on the residual
+path (standard Switch-style behavior).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.collectives import run_spmd, spmd_mesh
+
+__all__ = ["moe_forward", "init_moe_params", "make_ep_mesh",
+           "reference_moe"]
+
+
+def make_ep_mesh(n_experts: int, axis: str = "ep") -> Mesh:
+    return spmd_mesh(n_experts, axis)
+
+
+def init_moe_params(key, n_experts: int, hidden: int, ffn: int,
+                    dtype=jnp.float32):
+    """Router + per-expert FFN weights, experts stacked on a leading axis
+    (shards P('ep', ...))."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = jnp.asarray(np.sqrt(2.0 / hidden), dtype)
+    s2 = jnp.asarray(np.sqrt(2.0 / ffn), dtype)
+    return {
+        "Wg": jax.random.normal(k1, (hidden, n_experts), dtype) * s1,
+        "W1": jax.random.normal(k2, (n_experts, hidden, ffn), dtype) * s1,
+        "W2": jax.random.normal(k3, (n_experts, ffn, hidden), dtype) * s2,
+    }
+
+
+def _expert_ffn(x, W1, W2):
+    return jax.nn.gelu(x @ W1) @ W2
+
+
+def _route(x, Wg, n_experts, capacity):
+    """Top-1 routing with per-(rank, expert) capacity; returns expert id,
+    gate prob, bucket position, and keep mask per local token."""
+    logits = x @ Wg                                     # (n, E)
+    e = jnp.argmax(logits, axis=-1)                     # (n,)
+    p = jax.nn.softmax(logits, axis=-1)[jnp.arange(x.shape[0]), e]
+    onehot = jax.nn.one_hot(e, n_experts, dtype=jnp.int32)
+    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(x.shape[0]), e]
+    keep = pos < capacity
+    return e, p, pos, keep
+
+
+@functools.lru_cache(maxsize=32)
+def _moe_jit(mesh, capacity: int):
+    axis = mesh.axis_names[0]
+    E = mesh.shape[axis]
+
+    def kernel(x, Wg, W1, W2):
+        # x: (n, H) local tokens; W1/W2: (1, H, F)/(1, F, H) local expert
+        n, H = x.shape
+        e, p, pos, keep = _route(x, Wg, E, capacity)
+        posc = jnp.clip(pos, 0, capacity - 1)
+        # dispatch buffer: (E, C, H); dropped tokens contribute zeros
+        buf = jnp.zeros((E, capacity, H), x.dtype)
+        buf = buf.at[e, posc].add(x * keep[:, None])
+        recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                              tiled=True)               # (E, C, H)
+        y = _expert_ffn(recv.reshape(E * capacity, H), W1[0], W2[0])
+        back = lax.all_to_all(y.reshape(E, capacity, H), axis,
+                              split_axis=0, concat_axis=0, tiled=True)
+        yi = back[e, posc]                              # (n, H)
+        # combine: gated expert output for kept tokens, residual passthrough
+        # for capacity overflow
+        return jnp.where(keep[:, None], p[:, None] * yi, x)
+
+    return run_spmd(
+        kernel, mesh,
+        in_specs=(P(axis, None), P(), P(axis, None, None),
+                  P(axis, None, None)),
+        out_specs=P(axis, None))
+
+
+def moe_forward(params, x, mesh: Mesh, capacity: int | None = None):
+    """Route the (N, H) token-sharded batch through the expert-parallel
+    layer; returns (N, H) with the same sharding."""
+    x = jnp.asarray(x)
+    E = mesh.shape[mesh.axis_names[0]]
+    if params["W1"].shape[0] != E:
+        raise ValueError(
+            f"params have {params['W1'].shape[0]} experts, mesh has {E}")
+    if x.shape[0] % E:
+        raise ValueError(f"token count {x.shape[0]} must be divisible by "
+                         f"the {E} expert ranks")
+    n_local = x.shape[0] // E
+    if capacity is None:
+        capacity = max(1, int(np.ceil(2.0 * n_local / E)))
+    if capacity <= 0:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    return _moe_jit(mesh, int(capacity))(
+        x, params["Wg"], params["W1"], params["W2"])
+
+
+def reference_moe(params, x, capacity_per_rank_expert: int, n_ranks: int):
+    """Dense oracle replicating the routing + capacity semantics."""
+    x = np.asarray(x, np.float32)
+    E = params["Wg"].shape[1]
+    out = np.empty_like(x)
+    n_local = x.shape[0] // n_ranks
+    for r in range(n_ranks):
+        xs = x[r * n_local:(r + 1) * n_local]
+        logits = xs @ np.asarray(params["Wg"])
+        e = np.argmax(logits, axis=-1)
+        pz = np.exp(logits - logits.max(-1, keepdims=True))
+        pz = pz / pz.sum(-1, keepdims=True)
+        counts = {k: 0 for k in range(E)}
+        for i in range(n_local):
+            ei = int(e[i])
+            if counts[ei] < capacity_per_rank_expert:
+                counts[ei] += 1
+                h = np.asarray(_expert_ffn(jnp.asarray(xs[i:i + 1]),
+                                           jnp.asarray(params["W1"][ei]),
+                                           jnp.asarray(params["W2"][ei])))
+                out[r * n_local + i] = pz[i, ei] * h[0]
+            else:
+                out[r * n_local + i] = xs[i]
+    return out

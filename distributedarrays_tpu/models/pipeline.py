@@ -1,0 +1,117 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The reference's parallelism inventory stops at data/tensor/ring patterns
+(SURVEY.md §2 "DP/PP/EP: absent in reference — ring/halo + all-to-all
+cover the communication substrate they'd need").  This module builds PP on
+that substrate: each mesh rank along the ``pp`` axis owns one pipeline
+stage's weights; activations flow stage-to-stage with ``lax.ppermute``
+(the same neighbor shift as the halo exchange), and the whole
+fill-steady-drain schedule is one ``lax.fori_loop`` inside ONE compiled
+shard_map program — no per-tick dispatch, no host in the loop.
+
+Schedule: with P stages and M microbatches, T = M + P - 1 ticks; at tick
+``t`` stage ``s`` processes microbatch ``t - s`` (bubble ticks compute on
+zeros and are masked out of the output).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.collectives import run_spmd, spmd_mesh
+
+__all__ = ["pipeline_forward", "init_pipeline_params", "make_pp_mesh",
+           "reference_forward"]
+
+
+def make_pp_mesh(n_stages: int, axis: str = "pp") -> Mesh:
+    return spmd_mesh(n_stages, axis)
+
+
+def init_pipeline_params(key, n_stages: int, hidden: int,
+                         dtype=jnp.float32):
+    """One (hidden, hidden) layer + bias per stage, stacked on a leading
+    stage axis so the stack shards P('pp', ...)."""
+    keys = jax.random.split(key, n_stages)
+    W = jnp.stack([
+        jax.random.normal(k, (hidden, hidden), dtype) *
+        jnp.asarray(np.sqrt(1.0 / hidden), dtype) for k in keys])
+    b = jnp.zeros((n_stages, hidden), dtype)
+    return {"W": W, "b": b}
+
+
+def _stage_fn(x, W, b):
+    return jax.nn.gelu(x @ W + b)
+
+
+@functools.lru_cache(maxsize=32)
+def _pipeline_jit(mesh):
+    # one wrapper per mesh; jax retraces per microbatch shape internally
+    axis = mesh.axis_names[0]
+    nstg = mesh.shape[axis]
+
+    def kernel(mb, W, b):
+        # mb: (M, B, H) full microbatch stack (replicated);
+        # W: (1, H, H), b: (1, H): this stage's weights
+        me = lax.axis_index(axis)
+        Ws, bs = W[0], b[0]
+        M, B, H = mb.shape
+        T = M + nstg - 1
+        perm = [(i, i + 1) for i in range(nstg - 1)]     # no wraparound
+
+        def tick(t, carry):
+            recv, outs = carry
+            # stage 0 injects microbatch t (zeros during drain ticks)
+            mb_t = lax.dynamic_index_in_dim(
+                mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            x = jnp.where(me == 0, jnp.where(t < M, 1.0, 0.0) * mb_t, recv)
+            y = _stage_fn(x, Ws, bs)
+            # last stage banks microbatch (t - nstg + 1) when valid
+            oidx = jnp.clip(t - nstg + 1, 0, M - 1)
+            valid = (me == nstg - 1) & (t - nstg + 1 >= 0)
+            cur = lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, cur), oidx, 0)
+            # activation advances one stage (non-wrapping shift)
+            recv = lax.ppermute(y, axis, perm)
+            return recv, outs
+
+        recv0 = jnp.zeros((B, H), mb.dtype)
+        outs0 = jnp.zeros((M, B, H), mb.dtype)
+        _, outs = lax.fori_loop(0, T, tick, (recv0, outs0))
+        # broadcast the last stage's banked outputs to every rank
+        src = jnp.where(me == nstg - 1, 1.0, 0.0)
+        return lax.psum(outs * src, axis)
+
+    return run_spmd(
+        kernel, mesh,
+        in_specs=(P(), P(axis, None, None), P(axis, None)),
+        out_specs=P())
+
+
+def pipeline_forward(params, mb, mesh: Mesh):
+    """Run the (M, B, H) microbatch stack through the pipeline; returns the
+    (M, B, H) outputs (replicated)."""
+    mb = jnp.asarray(mb)
+    if mb.ndim != 3:
+        raise ValueError(f"microbatches must be (M, B, H), got {mb.shape}")
+    nstg = mesh.shape[mesh.axis_names[0]]
+    if params["W"].shape[0] != nstg:
+        raise ValueError(
+            f"params have {params['W'].shape[0]} stages, mesh has {nstg}")
+    return _pipeline_jit(mesh)(mb, params["W"], params["b"])
+
+
+def reference_forward(params, mb):
+    """Sequential oracle: apply every stage in order."""
+    x = jnp.asarray(mb)
+    for s in range(params["W"].shape[0]):
+        x = _stage_fn(x, params["W"][s], params["b"][s])
+    return x

@@ -1,0 +1,72 @@
+"""Pipeline-parallel and expert-parallel tests (SURVEY.md §2 parallelism
+inventory: PP/EP built on the ring-shift / all-to-all substrate)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributedarrays_tpu.models import moe as M
+from distributedarrays_tpu.models import pipeline as PP
+
+
+def test_pipeline_matches_sequential():
+    mesh = PP.make_pp_mesh(4)
+    params = PP.init_pipeline_params(jax.random.key(0), 4, 32)
+    mb = jax.random.normal(jax.random.key(1), (6, 8, 32))
+    got = PP.pipeline_forward(params, mb, mesh)
+    want = PP.reference_forward(params, mb)
+    assert float(jnp.abs(got - want).max()) < 1e-5
+
+
+def test_pipeline_eight_stages_single_microbatch():
+    mesh = PP.make_pp_mesh(8)
+    params = PP.init_pipeline_params(jax.random.key(2), 8, 16)
+    mb = jax.random.normal(jax.random.key(3), (1, 4, 16))
+    got = PP.pipeline_forward(params, mb, mesh)
+    want = PP.reference_forward(params, mb)
+    assert float(jnp.abs(got - want).max()) < 1e-5
+
+
+def test_pipeline_validation():
+    mesh = PP.make_pp_mesh(4)
+    params = PP.init_pipeline_params(jax.random.key(0), 2, 8)
+    with pytest.raises(ValueError, match="stages"):
+        PP.pipeline_forward(params, jnp.zeros((2, 2, 8)), mesh)
+    with pytest.raises(ValueError, match="microbatches"):
+        PP.pipeline_forward(
+            PP.init_pipeline_params(jax.random.key(0), 4, 8),
+            jnp.zeros((2, 8)), mesh)
+
+
+def test_moe_no_drop_matches_oracle():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(0), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    got = np.asarray(M.moe_forward(params, x, mesh, capacity=8))
+    want = M.reference_moe(params, x, 8, 4)
+    assert np.abs(got - want).max() < 1e-5
+
+
+def test_moe_capacity_overflow_passthrough():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(0), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    got = np.asarray(M.moe_forward(params, x, mesh, capacity=1))
+    want = M.reference_moe(params, x, 1, 4)
+    assert np.abs(got - want).max() < 1e-5
+    # with capacity 1 some tokens MUST pass through unchanged
+    assert np.any(np.all(got == np.asarray(x), axis=1))
+
+
+def test_moe_validation():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(0), 2, 16, 32)
+    with pytest.raises(ValueError, match="experts"):
+        M.moe_forward(params, jnp.zeros((8, 16)), mesh)
+    params4 = M.init_moe_params(jax.random.key(0), 4, 16, 32)
+    with pytest.raises(ValueError, match="divisible"):
+        M.moe_forward(params4, jnp.zeros((9, 16)), mesh)
+    with pytest.raises(ValueError, match="capacity"):
+        M.moe_forward(params4, jnp.zeros((8, 16)), mesh, capacity=0)
