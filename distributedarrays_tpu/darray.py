@@ -621,6 +621,23 @@ def _to_sharding(data: jax.Array, sharding) -> jax.Array:
     return jax.device_put(data, sharding)
 
 
+def _assemble_host(dims, dtype, parts, idxs_list) -> np.ndarray:
+    """Stitch per-chunk host buffers into one contiguous global array.
+
+    Uses the native thread-parallel copier (utils/native.py,
+    native/chunkcopy.cpp) when it can win; numpy slicing otherwise."""
+    host = np.empty(dims, dtype=dtype)
+    offs = [tuple(r.start for r in idx) for idx in idxs_list]
+    from .utils import native
+    if native.worth_using(host.nbytes, len(parts)):
+        native.assemble(host, [np.ascontiguousarray(p) for p in parts], offs)
+    else:
+        # numpy assignment handles non-contiguous sources directly
+        for c, idx in zip(parts, idxs_list):
+            host[tuple(slice(r.start, r.stop) for r in idx)] = c
+    return host
+
+
 def darray(init: Callable, dims, procs=None, dist=None) -> DArray:
     """Build a DArray by calling ``init(index_ranges)`` once per chunk.
 
@@ -649,9 +666,9 @@ def darray(init: Callable, dims, procs=None, dist=None) -> DArray:
                 f"chunk dtypes differ: {dtype} vs {p.dtype} "
                 "(reference requires homogeneous localparts, darray.jl:89-94)")
         parts[ci] = p
-    host = np.empty(dims, dtype=dtype)
-    for ci, p in parts.items():
-        host[tuple(slice(r.start, r.stop) for r in idxs[ci])] = p
+    order = list(parts.keys())
+    host = _assemble_host(dims, dtype, [parts[ci] for ci in order],
+                          [idxs[ci] for ci in order])
     return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
 
 
@@ -697,11 +714,13 @@ def from_chunks(chunks: np.ndarray, procs=None) -> DArray:
     idxs = np.empty(grid, dtype=object)
     dtype = np.result_type(*[np.asarray(chunks[ci]).dtype
                              for ci in np.ndindex(*grid)])
-    host = np.empty(dims, dtype=dtype)
+    parts, idxs_list = [], []
     for ci in np.ndindex(*grid):
         rngs = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1]) for d in range(nd))
         idxs[ci] = rngs
-        host[tuple(slice(r.start, r.stop) for r in rngs)] = np.asarray(chunks[ci])
+        parts.append(np.asarray(chunks[ci], dtype=dtype))
+        idxs_list.append(rngs)
+    host = _assemble_host(dims, dtype, parts, idxs_list)
     sharding = L.sharding_for(list(pids.flat), grid, dims)
     return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
 

@@ -1,3 +1,3 @@
-from . import checkpoint, profiling  # noqa: F401
+from . import checkpoint, debug, native, profiling  # noqa: F401
 from .checkpoint import load, save
 from .profiling import OpTimer, annotate, trace

