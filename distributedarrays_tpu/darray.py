@@ -725,6 +725,39 @@ def from_chunks(chunks: np.ndarray, procs=None) -> DArray:
     return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
 
 
+def darray_from_cuts(host, procs, cuts) -> DArray:
+    """Wrap an already-assembled global host/device array with an explicit
+    (possibly non-default) cut layout — one device_put, no chunk
+    round-trip.  Used by checkpoint restore; complements ``from_chunks``
+    (which assembles from separate chunk buffers)."""
+    cuts = [list(int(x) for x in c) for c in cuts]
+    dims = tuple(c[-1] for c in cuts)
+    if tuple(np.shape(host)) != dims:
+        raise ValueError(f"host shape {np.shape(host)} != cuts dims {dims}")
+    grid = tuple(len(c) - 1 for c in cuts)
+    n = int(np.prod(grid)) if grid else 1
+    procs = list(procs)
+    if len(procs) < n:
+        raise ValueError(f"layout {grid} needs {n} ranks, got {len(procs)}")
+    use = procs[:n]
+    pids = np.asarray(use, dtype=np.int64).reshape(grid)
+    idxs = np.empty(grid, dtype=object)
+    for ci in np.ndindex(*grid):
+        idxs[ci] = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1])
+                         for d in range(len(dims)))
+    # physical sharding: a dim is shardable only when its custom cuts are
+    # equal-sized (XLA's divisibility rule); else replicate that axis
+    mesh = L.mesh_for(use, grid)
+    names = []
+    for i, c in enumerate(cuts):
+        sizes = set(b - a for a, b in zip(c, c[1:]))
+        even = len(sizes) == 1 and 0 not in sizes
+        names.append(f"d{i}" if (grid[i] > 1 and even) else None)
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*names))
+    return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
+
+
 def dzeros(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
     """Distributed zeros (reference dzeros, darray.jl:460-476)."""
     dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)

@@ -1,0 +1,131 @@
+"""Hand-written Pallas TPU kernel: flash attention (blockwise, online
+softmax).
+
+This is the hot-op companion of ``models/ring_attention.py``: ring
+attention moves K/V blocks between chips with ``ppermute`` while each rank
+computes *local* blockwise attention — exactly the computation this kernel
+owns.  On TPU it keeps the running-max/normalizer/accumulator resident in
+VMEM while K/V blocks stream HBM→VMEM, so the S×S score matrix never
+materializes (pallas_guide.md: grid/BlockSpec streaming, scratch
+persistence across the innermost sequential grid axis).
+
+Layout: grid ``(heads, S/bq, S/bk)`` with the K axis innermost; scratch
+``m (bq,1)``, ``l (bq,1)``, ``acc (bq,d)`` persist across the K sweep for
+each (head, q-block) and flush to the output on the final K step.
+Causal masking compares global q/k positions derived from the grid ids.
+
+Interpreter mode runs the same kernel off-TPU for the CPU-mesh test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from .pallas_gemm import _on_tpu
+
+__all__ = ["flash_attention"]
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            scale: float, causal: bool, bq: int, bk: int, k_steps: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+    v = v_ref[0].astype(jnp.float32)                  # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+    if causal:
+        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+
+    m_prev = m_ref[:]                                 # (bq, 1)
+    blk_max = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, blk_max)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_ref[:] = m_new
+
+    @pl.when(ki == k_steps - 1)
+    def _flush():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
+    if pltpu is None:
+        raise RuntimeError("pallas TPU namespace unavailable")
+    k_steps = s // bk
+    kern = functools.partial(_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk, k_steps=k_steps)
+    call = pl.pallas_call(
+        kern,
+        grid=(h, s // bq, k_steps),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, s, d), jnp.dtype(dtype_str)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """Exact attention over (seq, heads, head_dim) arrays without
+    materializing the S×S score matrix.
+
+    Block sizes must divide the sequence length (blocks are clipped to S).
+    Use as the per-rank compute inside ring attention, or standalone
+    single-chip.
+    """
+    q, k, v = (jnp.asarray(x) for x in (q, k, v))
+    if q.shape != k.shape or q.shape != v.shape or q.ndim != 3:
+        raise ValueError(f"q/k/v must share (S, H, D), got {q.shape}, "
+                         f"{k.shape}, {v.shape}")
+    S, H, D = q.shape
+    bq, bk = min(block_q, S), min(block_k, S)
+    if S % bq or S % bk:
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) must divide seq len {S}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    sc = float(1.0 / np.sqrt(D) if scale is None else scale)
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    out = _build(H, S, D, bq, bk, str(q.dtype), sc, bool(causal),
+                 bool(interpret))(qh, kh, vh)
+    return jnp.transpose(out, (1, 0, 2))

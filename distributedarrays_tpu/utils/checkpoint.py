@@ -89,27 +89,11 @@ def _restore_darray(tree, arrays):
         return distribute(host)
     cuts = tree.get("cuts")
     if cuts is not None:
-        # rebuild the exact (possibly uneven / non-default) chunk layout by
-        # slicing the host array along the saved cuts; the native copy tier
-        # parallelizes the disassembly when it can win
-        from ..darray import from_chunks
-        from . import native
-        cells = list(np.ndindex(*dist))
-        shapes = [tuple(cuts[d][ci[d] + 1] - cuts[d][ci[d]]
-                        for d in range(len(dist))) for ci in cells]
-        offs = [tuple(cuts[d][ci[d]] for d in range(len(dist)))
-                for ci in cells]
-        if native.worth_using(host.nbytes, len(cells)):
-            parts = native.scatter_chunks(np.ascontiguousarray(host),
-                                          shapes, offs)
-        else:
-            parts = [host[tuple(slice(o[d], o[d] + s[d])
-                                for d in range(len(dist)))]
-                     for s, o in zip(shapes, offs)]
-        grid = np.empty(tuple(dist), dtype=object)
-        for ci, p in zip(cells, parts):
-            grid[ci] = p
-        return from_chunks(grid, procs=procs)
+        # restore the exact (possibly uneven / non-default) chunk layout:
+        # the saved host array is already assembled, so wrap it directly —
+        # one device_put, no chunk split/reassemble round-trip
+        from ..darray import darray_from_cuts
+        return darray_from_cuts(host, procs, cuts)
     return distribute(host, procs=procs, dist=dist)
 
 

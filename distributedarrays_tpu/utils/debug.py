@@ -25,35 +25,43 @@ from ..darray import DArray
 __all__ = ["validate", "check_all"]
 
 
+def _check(cond: bool, msg: str) -> None:
+    # explicit raise (not `assert`) so the checker still works under
+    # `python -O`, where asserts are compiled out
+    if not cond:
+        raise AssertionError(msg)
+
+
 def validate(d: DArray) -> None:
     """Raise AssertionError with a precise message on any broken layout
     invariant of ``d``."""
-    assert not d._closed, f"{d.id}: closed DArray"
-    assert d.id in core.registry(), f"{d.id}: missing from registry"
+    _check(not d._closed, f"{d.id}: closed DArray")
+    _check(d.id in core.registry(), f"{d.id}: missing from registry")
     nd = len(d.dims)
-    assert len(d.cuts) == nd, f"{d.id}: {len(d.cuts)} cut vectors, {nd} dims"
+    _check(len(d.cuts) == nd, f"{d.id}: {len(d.cuts)} cut vectors, {nd} dims")
     for dim, c in enumerate(d.cuts):
-        assert c[0] == 0 and c[-1] == d.dims[dim], \
-            f"{d.id}: cuts[{dim}]={c} do not span [0, {d.dims[dim]}]"
-        assert all(a <= b for a, b in zip(c, c[1:])), \
-            f"{d.id}: cuts[{dim}]={c} not monotone"
-        assert len(c) == d.pids.shape[dim] + 1, \
-            f"{d.id}: cuts[{dim}] has {len(c)} entries for " \
-            f"{d.pids.shape[dim]} chunks"
-    assert d.indices.shape == d.pids.shape, \
-        f"{d.id}: indices grid {d.indices.shape} != pid grid {d.pids.shape}"
+        _check(c[0] == 0 and c[-1] == d.dims[dim],
+               f"{d.id}: cuts[{dim}]={c} do not span [0, {d.dims[dim]}]")
+        _check(all(a <= b for a, b in zip(c, c[1:])),
+               f"{d.id}: cuts[{dim}]={c} not monotone")
+        _check(len(c) == d.pids.shape[dim] + 1,
+               f"{d.id}: cuts[{dim}] has {len(c)} entries for "
+               f"{d.pids.shape[dim]} chunks")
+    _check(d.indices.shape == d.pids.shape,
+           f"{d.id}: indices grid {d.indices.shape} != pid grid {d.pids.shape}")
     for ci in np.ndindex(*d.pids.shape):
         idx = d.indices[ci]
         for dim in range(nd):
             want = range(d.cuts[dim][ci[dim]], d.cuts[dim][ci[dim] + 1])
-            assert idx[dim] == want, \
-                f"{d.id}: indices[{ci}][{dim}]={idx[dim]} != cuts-derived {want}"
+            _check(idx[dim] == want,
+                   f"{d.id}: indices[{ci}][{dim}]={idx[dim]} != "
+                   f"cuts-derived {want}")
     g = d.garray
-    assert tuple(g.shape) == d.dims, \
-        f"{d.id}: payload shape {g.shape} != dims {d.dims}"
+    _check(tuple(g.shape) == d.dims,
+           f"{d.id}: payload shape {g.shape} != dims {d.dims}")
     navail = L.nranks()
     for p in d.pids.flat:
-        assert 0 <= int(p) < navail, f"{d.id}: rank {p} out of range"
+        _check(0 <= int(p) < navail, f"{d.id}: rank {p} out of range")
 
 
 def check_all() -> int:

@@ -42,11 +42,20 @@ def _load():
                     or (_SRC.exists()
                         and _SO.stat().st_mtime < _SRC.stat().st_mtime)):
                 _BUILD.mkdir(exist_ok=True)
+                # compile to a process-unique temp path and atomically
+                # rename, so concurrent processes never dlopen a
+                # half-written .so
+                tmp = _SO.with_suffix(f".{os.getpid()}.tmp.so")
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     "-o", str(_SO), str(_SRC)],
+                     "-o", str(tmp), str(_SRC)],
                     check=True, capture_output=True, timeout=120)
-            lib = ctypes.CDLL(str(_SO))
+                os.replace(tmp, _SO)
+            try:
+                lib = ctypes.CDLL(str(_SO))
+            except OSError:
+                # racing writer may have just replaced the file; retry once
+                lib = ctypes.CDLL(str(_SO))
             lib.chunk_copy.restype = ctypes.c_int
             lib.chunk_copy.argtypes = [
                 ctypes.c_char_p,                      # dst
