@@ -745,16 +745,10 @@ def darray_from_cuts(host, procs, cuts) -> DArray:
     for ci in np.ndindex(*grid):
         idxs[ci] = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1])
                          for d in range(len(dims)))
-    # physical sharding: a dim is shardable only when its custom cuts are
-    # equal-sized (XLA's divisibility rule); else replicate that axis
-    mesh = L.mesh_for(use, grid)
-    names = []
-    for i, c in enumerate(cuts):
-        sizes = set(b - a for a, b in zip(c, c[1:]))
-        even = len(sizes) == 1 and 0 not in sizes
-        names.append(f"d{i}" if (grid[i] > 1 and even) else None)
-    sharding = jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec(*names))
+    # physical sharding follows the same dims-divisibility rule as every
+    # other constructor (L.sharding_for): logical cuts may be uneven while
+    # the physical layout stays sharded wherever XLA allows
+    sharding = L.sharding_for(use, grid, dims)
     return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
 
 

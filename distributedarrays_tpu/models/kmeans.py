@@ -67,11 +67,34 @@ def kmeans(d: DArray, k: int, iters: int = 20, seed: int = 0):
     n = d.dims[0]
     if not (0 < k <= n):
         raise ValueError(f"need 0 < k <= n, got k={k}, n={n}")
-    idx = np.sort(np.random.default_rng(seed).choice(n, size=k,
-                                                     replace=False))
-    C0 = d.garray[jnp.asarray(idx)]
+    C0 = jnp.asarray(_kmeanspp_init(d, k, seed), dtype=d.dtype)
     C, shifts = _kmeans_jit(int(iters))(d.garray, C0)
     return C, np.asarray(shifts)
+
+
+def _kmeanspp_init(d: DArray, k: int, seed: int) -> np.ndarray:
+    """k-means++ seeding on a host-side sample (≤4096 points): spread the
+    initial centroids proportionally to squared distance, avoiding the
+    duplicate-cluster local optima of uniform random picks."""
+    n = d.dims[0]
+    rng = np.random.default_rng(seed)
+    m = min(n, 4096)
+    sel = np.sort(rng.choice(n, size=m, replace=False)) if m < n \
+        else np.arange(n)
+    S = np.asarray(jax.device_get(d.garray[jnp.asarray(sel)]), np.float32)
+    C = np.empty((k, S.shape[1]), np.float32)
+    C[0] = S[rng.integers(m)]
+    d2 = np.sum((S - C[0]) ** 2, axis=1)
+    for j in range(1, k):
+        s = float(d2.sum())
+        if s > 0:
+            C[j] = S[rng.choice(m, p=d2 / s)]
+        else:
+            # all remaining sample points coincide with a centroid
+            # (duplicate-heavy data): fall back to a uniform pick
+            C[j] = S[rng.integers(m)]
+        d2 = np.minimum(d2, np.sum((S - C[j]) ** 2, axis=1))
+    return C
 
 
 @functools.lru_cache(maxsize=None)

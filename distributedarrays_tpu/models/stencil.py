@@ -27,9 +27,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import layout as L
 from ..darray import DArray, _wrap_global, distribute
-from ..parallel.collectives import halo_exchange
+from ..parallel.collectives import halo_exchange, halo_exchange_2d
 
-__all__ = ["stencil5_step", "stencil5", "life_step", "life"]
+__all__ = ["stencil5_step", "stencil5", "life_step", "life", "life2d"]
 
 
 def _row_mesh(d: DArray):
@@ -118,6 +118,47 @@ def _life_jit(mesh, iters: int):
     return jax.jit(jax.shard_map(many, mesh=mesh,
                                  in_specs=P(axis, None),
                                  out_specs=P(axis, None), check_vma=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _life2d_jit(mesh, iters: int):
+    ax0, ax1 = mesh.axis_names[0], mesh.axis_names[1]
+
+    def step(block):
+        xp = halo_exchange_2d(block, (ax0, ax1), halo=1, wrap=False)
+        neigh = (xp[:-2, :-2] + xp[:-2, 1:-1] + xp[:-2, 2:] +
+                 xp[1:-1, :-2] + xp[1:-1, 2:] +
+                 xp[2:, :-2] + xp[2:, 1:-1] + xp[2:, 2:])
+        alive = xp[1:-1, 1:-1]
+        born = (alive == 0) & (neigh == 3)
+        survive = (alive == 1) & ((neigh == 2) | (neigh == 3))
+        return jnp.where(born | survive, 1, 0).astype(block.dtype)
+
+    def many(block):
+        def body(b, _):
+            return step(b), None
+        out, _ = lax.scan(body, block, None, length=iters)
+        return out
+
+    return jax.jit(jax.shard_map(many, mesh=mesh,
+                                 in_specs=P(ax0, ax1),
+                                 out_specs=P(ax0, ax1), check_vma=False))
+
+
+def life2d(d: DArray, iters: int = 1) -> DArray:
+    """Game of Life on a fully 2-D-sharded grid: both dimensions
+    distributed, corners exchanged via the two-phase 2-D halo (the
+    reference's Life demo, docs/src/index.md:160-204, at its most general
+    layout)."""
+    pids = [int(p) for p in d.pids.flat]
+    g0, g1 = d.pids.shape
+    if d.dims[0] % g0 or d.dims[1] % g1:
+        raise ValueError(
+            f"life2d needs an even layout; got grid {d.pids.shape} for "
+            f"dims {d.dims}")
+    mesh = L.mesh_for(pids, (g0, g1))
+    res = _life2d_jit(mesh, int(iters))(d.garray)
+    return _wrap_global(res, procs=pids, dist=[g0, g1])
 
 
 def life_step(d: DArray) -> DArray:

@@ -107,6 +107,26 @@ def halo_exchange(x, axis: str, halo: int = 1, dim: int = 0,
     return lo, hi
 
 
+def halo_exchange_2d(x, axes: tuple[str, str], halo: int = 1,
+                     wrap: bool = False):
+    """Full 2-D halo exchange including corners.
+
+    ``x`` is this rank's (m, n) block on a 2-D mesh ``axes = (row_axis,
+    col_axis)``.  Returns the (m + 2h, n + 2h) block padded with the
+    neighbors' boundary data (zeros at the global edge when ``wrap`` is
+    False).  Corners arrive correctly because the column exchange runs on
+    the already row-extended block — the standard two-phase scheme, four
+    ``ppermute``s total.
+    """
+    row_axis, col_axis = axes
+    # phase 1: exchange rows along the row axis
+    lo, hi = halo_exchange(x, row_axis, halo=halo, dim=0, wrap=wrap)
+    xr = jnp.concatenate([lo, x, hi], axis=0)          # (m + 2h, n)
+    # phase 2: exchange columns of the extended block along the col axis
+    lo2, hi2 = halo_exchange(xr, col_axis, halo=halo, dim=1, wrap=wrap)
+    return jnp.concatenate([lo2, xr, hi2], axis=1)     # (m + 2h, n + 2h)
+
+
 def pbarrier(axis: str):
     """Synchronization point: all ranks must reach it before any proceeds
     (reference barrier, spmd.jl:159-184).  In a compiled SPMD program this

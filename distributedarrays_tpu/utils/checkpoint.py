@@ -50,9 +50,19 @@ def _encode(tree, arrays: dict):
                 "pids": [int(p) for p in tree.pids]}
     if isinstance(tree, (jax.Array, np.ndarray)):
         key = f"a{len(arrays)}"
-        arrays[key] = np.asarray(tree)
-        return {"__dartpu__": "ndarray", "key": key,
-                "jax": isinstance(tree, jax.Array)}
+        host = np.asarray(tree)
+        entry = {"__dartpu__": "ndarray", "key": key,
+                 "jax": isinstance(tree, jax.Array)}
+        import ml_dtypes
+        if host.dtype.kind == "V" and hasattr(ml_dtypes, host.dtype.name):
+            # ml_dtypes (bfloat16, fp8, ...) don't survive npz round-trips;
+            # store raw bytes + the dtype name and re-view at load.
+            # (structured void dtypes fall through — npz handles those.)
+            entry["mldtype"] = host.dtype.name
+            entry["shape"] = list(host.shape)
+            host = np.frombuffer(host.tobytes(), dtype=np.uint8)
+        arrays[key] = host
+        return entry
     if isinstance(tree, dict):
         if all(isinstance(k, str) for k in tree) and \
                 not any(k == "__dartpu__" for k in tree):
@@ -109,6 +119,11 @@ def _decode(tree, arrays):
                     for k, v in tree["items"]}
         if tag == "ndarray":
             host = arrays[tree["key"]]
+            if "mldtype" in tree:
+                import ml_dtypes
+                dt = np.dtype(getattr(ml_dtypes, tree["mldtype"]))
+                host = np.frombuffer(host.tobytes(), dtype=dt).reshape(
+                    tree["shape"]).copy()   # frombuffer views are read-only
             return jax.numpy.asarray(host) if tree["jax"] else host
         if tag == "DData":
             from ..darray import DData as _DData

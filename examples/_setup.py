@@ -1,0 +1,22 @@
+"""Shared example bootstrap: put the repo on sys.path and pick devices.
+
+If an accelerator platform is configured (JAX_PLATFORMS names one, e.g. a
+TPU), the examples run on it.  Otherwise — or when EXAMPLES_FORCE_CPU=1 —
+they fall back to a virtual 8-device CPU mesh so they run anywhere."""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+_platforms = os.environ.get("JAX_PLATFORMS", "")
+_has_accel = any(p and p != "cpu" for p in _platforms.split(","))
+if os.environ.get("EXAMPLES_FORCE_CPU") == "1" or not _has_accel:
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")

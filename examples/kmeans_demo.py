@@ -1,0 +1,29 @@
+"""Distributed k-means with a convergence trace."""
+
+import _setup  # noqa: F401
+
+import numpy as np
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.models import kmeans
+
+rng = np.random.default_rng(1)
+centers = rng.uniform(-10, 10, size=(5, 8)).astype(np.float32)
+pts = np.concatenate([
+    c + 0.4 * rng.standard_normal((2000, 8)).astype(np.float32)
+    for c in centers])
+rng.shuffle(pts)
+
+d = dat.distribute(pts)
+print("points:", d.dims, "chunk grid:", d.pids.shape)
+
+C, shifts = kmeans.kmeans(d, k=5, iters=25, seed=3)
+print("centroid shift per iter:", np.array2string(shifts[:8], precision=4))
+recovered = sorted(np.min(np.linalg.norm(np.asarray(C) - c, axis=1))
+                   for c in centers)
+print("distance from each true center to nearest centroid:",
+      [f"{x:.3f}" for x in recovered])
+
+labels = kmeans.assign(d, C)
+print("label counts:", np.bincount(np.asarray(labels)))
+dat.d_closeall()

@@ -1,0 +1,44 @@
+"""Quickstart: the minimum end-to-end slice — distribute, compute, reduce,
+gather (the reference's core workflow, TPU-native)."""
+
+import _setup  # noqa: F401
+
+import numpy as np
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+
+# construct distributed arrays (generated on device, sharded over the mesh)
+A = dat.drand((1024, 1024))
+B = dat.drand((1024, 1024))
+print("A:", A)
+print("A sharding:", A.garray.sharding)
+
+# owner-computes elementwise math; whole chains fuse under djit
+C = dat.dmap(jnp.sin, A) + B * 2.0
+fused = dat.djit(lambda a, b: jnp.sin(a) + b * 2.0)(A, B)
+assert C == fused
+
+# reductions: local reduce per device + all-reduce over ICI
+print("sum:", float(dat.dsum(C)), " mean:", float(dat.dmean(C)))
+
+# distributed GEMM on the MXU
+G = A @ B
+print("GEMM result:", G.dims, "fro-norm:", float(dat.dnorm(G)))
+
+# layout inspection and localparts
+print("chunk grid:", A.pids.shape, " cuts[0][:3]:", A.cuts[0][:3])
+print("rank 0 owns:", A.localindices(0))
+
+# scalar reads are guarded (they gather from HBM)
+try:
+    C[0, 0]
+except RuntimeError as e:
+    print("guarded:", str(e)[:60], "...")
+with dat.allowscalar(True):
+    print("C[0,0] =", float(C[0, 0]))
+
+# gather to host, clean up
+host = np.asarray(C)
+print("gathered:", host.shape, host.dtype)
+dat.d_closeall()

@@ -1,0 +1,27 @@
+"""Sequence-parallel exact attention over the device mesh: K/V blocks ride
+a ppermute ring while each rank keeps an online-softmax accumulator."""
+
+import _setup  # noqa: F401
+
+import numpy as np
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.models import ring_attention as RA
+
+S, H, D = 512, 8, 64
+rng = np.random.default_rng(0)
+mk = lambda: rng.standard_normal((S, H, D)).astype(np.float32)
+q, k, v = mk(), mk(), mk()
+
+dist = (8, 1, 1)   # sequence dim sharded over all ranks
+dq = dat.distribute(q, procs=range(8), dist=dist)
+dk = dat.distribute(k, procs=range(8), dist=dist)
+dv = dat.distribute(v, procs=range(8), dist=dist)
+
+out = RA.ring_attention(dq, dk, dv, causal=True)
+print("output:", out.dims, "sharded", out.pids.shape)
+
+want = RA.reference_attention(q, k, v, causal=True)
+err = np.abs(np.asarray(out) - want).max()
+print(f"max |ring - dense| = {err:.2e}  (exact up to f32 round-off)")
+dat.d_closeall()

@@ -1,0 +1,43 @@
+"""MPI-style dynamic SPMD: tagged ring send/recv, collectives, contexts
+(reference spmd.jl usage, docs/src/index.md:285-457)."""
+
+import _setup  # noqa: F401
+
+from distributedarrays_tpu import parallel as par
+from distributedarrays_tpu.parallel import (barrier, bcast, context,
+                                            context_local_storage,
+                                            gather_spmd, myid, recvfrom,
+                                            scatter, sendto, spmd)
+
+NP = 8
+
+
+def ring_program():
+    me = myid()
+    nxt, prv = (me + 1) % NP, (me - 1) % NP
+    # pass a token around the ring, accumulating rank ids
+    token = [me] if me == 0 else None
+    if me == 0:
+        sendto(nxt, token, tag="ring")
+        token = recvfrom(prv, tag="ring")      # full circle
+    else:
+        token = recvfrom(prv, tag="ring")
+        token.append(me)
+        sendto(nxt, token, tag="ring")
+    barrier()
+    # collectives
+    word = bcast("hello" if me == 3 else None, root=3)
+    part = scatter(list(range(2 * NP)) if me == 0 else None, root=0)
+    sums = gather_spmd(sum(part), root=0)
+    ctx_store = context_local_storage()
+    ctx_store["visits"] = ctx_store.get("visits", 0) + 1
+    return token if me == 0 else (word, part, sums)
+
+
+ctx = context()
+out = spmd(ring_program, context=ctx)
+print("rank 0 saw the full ring:", out[0])
+print("rank 5 got:", out[5])
+out2 = spmd(ring_program, context=ctx)   # storage persists across runs
+counts = spmd(lambda: context_local_storage()["visits"], context=ctx)
+print("context-local visit counts:", counts)

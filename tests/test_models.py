@@ -75,6 +75,26 @@ def test_life_glider_translates():
     assert np.array_equal(got, want)
 
 
+def test_life2d_matches_oracle(rng):
+    # fully 2-D-sharded grid (4x2 mesh): corner exchange must be exact
+    A = (rng.random((32, 24)) < 0.4).astype(np.int32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    got = np.asarray(stencil.life2d(d, iters=5))
+    assert np.array_equal(got, _life_oracle(A, 5))
+
+
+def test_life2d_glider_crosses_corner():
+    # glider path crosses both a row and a column chunk boundary
+    A = np.zeros((32, 32), np.int32)
+    glider = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.int32)
+    A[11:14, 11:14] = glider     # starts near the (16,16) corner
+    d = dat.distribute(A, procs=range(4), dist=(2, 2))
+    got = np.asarray(stencil.life2d(d, iters=20))
+    want = np.zeros_like(A)
+    want[16:19, 16:19] = glider  # 5 diagonal moves
+    assert np.array_equal(got, want)
+
+
 def test_mlp_train_step_loss_decreases():
     mesh = mlp.make_mesh(8)
     sizes = [32, 64, 16]

@@ -61,6 +61,16 @@ def test_checkpoint_preserves_keys_and_scalar_types(tmp_path):
     assert back["flag"].dtype == np.bool_
 
 
+def test_checkpoint_bfloat16_roundtrip(tmp_path):
+    # regression: ml_dtypes arrays (bfloat16) don't survive npz natively
+    w = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4) / 7
+    checkpoint.save(tmp_path / "cbf", {"w": w, "host": np.asarray(w)})
+    back = checkpoint.load(tmp_path / "cbf")
+    assert back["w"].dtype == jnp.bfloat16
+    assert jnp.array_equal(back["w"], w)
+    assert back["host"].dtype == np.asarray(w).dtype
+
+
 def test_checkpoint_rejects_unknown_leaf(tmp_path):
     with pytest.raises(TypeError):
         checkpoint.save(tmp_path / "c3", {"f": open})
