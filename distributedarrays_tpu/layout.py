@@ -43,7 +43,6 @@ __all__ = [
     "defaultdist_1d",
     "chunk_idxs",
     "locate",
-    "locate_point",
     "mesh_for",
     "sharding_for",
     "prime_factors",
@@ -176,10 +175,6 @@ def locate(cuts: Sequence[Sequence[int]], *I: int) -> tuple[int, ...]:
             j += 1
         out.append(j)
     return tuple(out)
-
-
-def locate_point(cuts, I):
-    return locate(cuts, *I)
 
 
 # ---------------------------------------------------------------------------

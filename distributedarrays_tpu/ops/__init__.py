@@ -1,1 +1,2 @@
-from . import broadcast, mapreduce  # noqa: F401
+from . import broadcast, linalg, mapreduce, pallas_attention, pallas_gemm, \
+    sort, sparse  # noqa: F401

@@ -12,11 +12,17 @@ Mirrors the reference harness (/root/reference/test/runtests.jl):
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the virtual CPU mesh
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# DAT_TEST_TPU=1 runs the suite on whatever real devices JAX sees (tests
+# needing >1 device will fail on a 1-chip host — intended for real slices);
+# default is the virtual 8-device CPU mesh, the reference's addprocs analog.
+_ON_REAL = os.environ.get("DAT_TEST_TPU") == "1"
+
+if not _ON_REAL:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import gc
 
@@ -25,10 +31,11 @@ import pytest
 
 import jax
 
-# this image's sitecustomize pre-sets jax_platforms="axon,cpu" at interpreter
-# startup, which outranks the env var — force CPU via the config API before
-# any backend is initialized
-jax.config.update("jax_platforms", "cpu")
+if not _ON_REAL:
+    # this image's sitecustomize pre-sets jax_platforms="axon,cpu" at
+    # interpreter startup, which outranks the env var — force CPU via the
+    # config API before any backend is initialized
+    jax.config.update("jax_platforms", "cpu")
 
 import distributedarrays_tpu as dat
 
@@ -56,5 +63,6 @@ def rng():
 
 
 def pytest_configure(config):
-    assert len(jax.devices()) == 8, (
-        f"test harness expects 8 virtual devices, got {jax.devices()}")
+    if not _ON_REAL:
+        assert len(jax.devices()) == 8, (
+            f"test harness expects 8 virtual devices, got {jax.devices()}")

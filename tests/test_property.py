@@ -1,0 +1,99 @@
+"""Property-based tests (hypothesis): random layouts and shapes against the
+numpy oracle — the breadth analog of the reference's exhaustive
+Array-vs-DArray comparisons (test/darray.jl throughout)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import layout as L
+
+
+dims_2d = st.tuples(st.integers(1, 64), st.integers(1, 48))
+nranks = st.integers(1, 8)
+
+
+@settings(max_examples=40, deadline=None)
+@given(sz=st.integers(1, 500), nc=st.integers(1, 12))
+def test_cuts_tile_exactly(sz, nc):
+    cuts = L.defaultdist_1d(sz, nc)
+    assert len(cuts) == nc + 1
+    assert cuts[0] == 0 and cuts[-1] == sz
+    sizes = np.diff(cuts)
+    assert (sizes >= 0).all()
+    # remainder spreads over LEADING chunks: sizes are non-increasing and
+    # differ by at most one (darray.jl:279-296)
+    assert sizes.max() - sizes.min() <= 1
+    assert all(a >= b for a, b in zip(sizes, sizes[1:]))
+
+
+@settings(max_examples=30, deadline=None)
+@given(dims=dims_2d, n=nranks, data=st.data())
+def test_distribute_roundtrip_any_layout(dims, n, data):
+    # any chunk grid whose cell count fits the ranks
+    g0 = data.draw(st.integers(1, n))
+    g1 = data.draw(st.integers(1, max(1, n // g0)))
+    A = np.arange(np.prod(dims), dtype=np.float32).reshape(dims)
+    d = dat.distribute(A, procs=range(n), dist=(g0, g1))
+    assert np.array_equal(np.asarray(d), A)
+    # localparts tile the array exactly
+    seen = np.full(dims, -1.0, np.float32)
+    for pid in sorted(set(int(p) for p in d.pids.flat)):
+        li = d.localindices(pid)
+        lp = np.asarray(d.localpart(pid))
+        seen[np.ix_(list(li[0]), list(li[1]))] = lp
+    assert np.array_equal(seen, A)
+    d.close()
+
+
+@settings(max_examples=30, deadline=None)
+@given(dims=dims_2d, data=st.data())
+def test_elementwise_and_reduce_match_numpy(dims, data):
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    A = rng.standard_normal(dims).astype(np.float32)
+    B = rng.standard_normal(dims).astype(np.float32)
+    da, db = dat.distribute(A), dat.distribute(B)
+    r = da * 2.0 - db
+    assert np.allclose(np.asarray(r), A * 2.0 - B, rtol=1e-5, atol=1e-5)
+    assert np.allclose(float(dat.dsum(r)), (A * 2.0 - B).sum(),
+                       rtol=1e-3, atol=1e-3)
+    ax = data.draw(st.sampled_from([0, 1]))
+    m = dat.dmaximum(da, dims=ax)
+    assert np.allclose(np.asarray(m), A.max(axis=ax, keepdims=True))
+    dat.d_closeall()
+
+
+@settings(max_examples=25, deadline=None)
+@given(n=st.integers(8, 512), data=st.data())
+def test_sort_matches_numpy(n, data):
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    kind = data.draw(st.sampled_from(["normal", "dupes", "sorted", "rev"]))
+    if kind == "normal":
+        x = rng.standard_normal(n).astype(np.float32)
+    elif kind == "dupes":
+        x = rng.integers(0, 5, n).astype(np.float32)
+    elif kind == "sorted":
+        x = np.sort(rng.standard_normal(n)).astype(np.float32)
+    else:
+        x = np.sort(rng.standard_normal(n))[::-1].astype(np.float32).copy()
+    s = dat.dsort(dat.distribute(x))
+    assert np.array_equal(np.asarray(s), np.sort(x))
+    dat.d_closeall()
+
+
+@settings(max_examples=25, deadline=None)
+@given(dims=dims_2d, data=st.data())
+def test_view_slices_match_numpy(dims, data):
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**31)))
+    A = rng.standard_normal(dims).astype(np.float32)
+    d = dat.distribute(A)
+    i0 = data.draw(st.integers(0, dims[0] - 1))
+    i1 = data.draw(st.integers(i0, dims[0]))
+    j0 = data.draw(st.integers(0, dims[1] - 1))
+    j1 = data.draw(st.integers(j0, dims[1]))
+    v = d[i0:i1, j0:j1]
+    assert np.array_equal(np.asarray(v), A[i0:i1, j0:j1])
+    dat.d_closeall()
