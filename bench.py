@@ -49,7 +49,43 @@ def _marginal(run_for_length, L0=10, min_delta=0.05, max_L=1000):
         L *= 4
 
 
+def _device_watchdog(timeout_s: float = 480.0) -> bool:
+    """Probe the accelerator with a tiny op under a hard timeout: a wedged
+    remote tunnel hangs forever instead of erroring, and the harness must
+    fail loudly rather than stall the driver."""
+    import threading
+
+    result = {"ok": False, "error": f"device probe timed out after "
+                                    f"{timeout_s:.0f}s (wedged tunnel?)"}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+            v = float(jnp.sum(jnp.ones((8, 8))))
+            if v == 64.0:
+                result["ok"] = True
+            else:
+                result["error"] = f"device probe returned {v}, expected 64.0"
+        except Exception as e:  # surface the real failure, not a fake timeout
+            result["error"] = f"device probe raised: {type(e).__name__}: {e}"
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return result
+
+
 def main():
+    probe = _device_watchdog()
+    if not probe["ok"]:
+        print(json.dumps({
+            "metric": "gemm_4096_f32_gflops", "value": 0.0, "unit": "GFLOPS",
+            "vs_baseline": 0.0,
+            "error": f"accelerator unreachable ({probe['error']})",
+        }))
+        return
+
     import jax
     import jax.numpy as jnp
     from jax import lax

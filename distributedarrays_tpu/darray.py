@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import numbers
+import threading
 import weakref
 from typing import Any, Callable, Sequence
 
@@ -143,6 +144,7 @@ class DArray:
         "_data",
         "_sharding",
         "_closed",
+        "_mutlock",
         "__weakref__",
     )
 
@@ -156,6 +158,10 @@ class DArray:
         self._data = data
         self._sharding = data.sharding
         self._closed = False
+        # serializes read-modify-write mutations (set_localpart/setitem)
+        # from concurrent SPMD rank tasks: the reference's workers own
+        # disjoint chunks in separate processes, here they share one buffer
+        self._mutlock = threading.Lock()
         core.register(self)
         # finalizer → close_by_id fan-out in the reference (darray.jl:47-49);
         # here plain refcounting already frees HBM, the finalizer only
@@ -318,7 +324,7 @@ class DArray:
         if value.shape != want:
             raise ValueError(f"localpart shape {value.shape} != chunk shape {want}")
         sl = tuple(slice(r.start, r.stop) for r in idx)
-        self._rebind(self._data.at[sl].set(value))
+        self._mutate(lambda g: g.at[sl].set(value))
 
     def locate(self, *I: int) -> tuple:
         """Chunk-grid coordinates owning global index I (darray.jl:448-456)."""
@@ -336,6 +342,13 @@ class DArray:
     def _gather_host(self):
         self._check_open()
         return jax.device_get(self._data)
+
+    def _mutate(self, updater):
+        """Atomic read-modify-write of the backing buffer: every partial
+        mutation (chunk/region updates) must go through here so concurrent
+        SPMD rank tasks cannot lose each other's disjoint writes."""
+        with self._mutlock:
+            self._rebind(updater(self.garray))
 
     def _rebind(self, new_data: jax.Array):
         """Swap the backing buffer in place (mutation-API support)."""
@@ -377,7 +390,7 @@ class DArray:
             value = value.garray
         elif isinstance(value, SubDArray):
             value = value.materialize()
-        self._rebind(self._data.at[tuple(key)].set(value))
+        self._mutate(lambda g: g.at[tuple(key)].set(value))
 
     def makelocal(self, *I) -> jax.Array:
         """Materialize the region ``I`` as a dense local array
@@ -954,7 +967,7 @@ def copyto_(dest, src) -> "DArray":
             # same contract as the DArray path / reference DimensionMismatch
             raise ValueError(f"copyto_: src shape {tuple(val.shape)} != view "
                              f"shape {tuple(dest.shape)}")
-        parent._rebind(parent.garray.at[tuple(key)].set(val))
+        parent._mutate(lambda g: g.at[tuple(key)].set(val))
         return dest
     if not isinstance(dest, DArray):
         raise TypeError("copyto_ expects a DArray or SubDArray destination")

@@ -201,6 +201,22 @@ def test_collective_root_validation():
     assert "root 7" in str(ei.value.__cause__)
 
 
+def test_concurrent_set_localpart_all_land(rng):
+    # every rank rewrites its own chunk concurrently; all 8 disjoint
+    # updates must land (read-modify-write rebind is serialized)
+    A = rng.standard_normal((64, 4)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(8, 1))
+    def prog():
+        me = S.myid()
+        d.set_localpart(np.full((8, 4), float(me), np.float32))
+        return True
+    assert all(S.spmd(prog))
+    got = np.asarray(d)
+    for r in range(8):
+        assert np.all(got[8 * r:8 * (r + 1)] == r), f"rank {r} update lost"
+    d.close()
+
+
 def test_outside_spmd_raises():
     with pytest.raises(RuntimeError, match="spmd"):
         S.sendto(0, "x")
