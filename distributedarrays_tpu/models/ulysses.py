@@ -1,0 +1,112 @@
+"""Ulysses-style all-to-all sequence parallelism — the second long-context
+strategy (complementing ring attention).
+
+Where ring attention keeps Q resident and rotates K/V around the mesh
+(O(P) ppermute hops), the Ulysses pattern re-partitions once: tokens are
+sequence-sharded; one ``lax.all_to_all`` turns the layout into
+head-sharded-with-full-sequence, each rank runs *complete* attention for
+its heads (here: the Pallas flash kernel or a dense jnp path), and a
+second all_to_all restores sequence sharding.  Two collectives total,
+O(seq·d/P) traffic per rank — the better trade when heads ≥ ranks and ICI
+all-to-all bandwidth is plentiful; ring wins when sequence lengths dwarf
+HBM.  Both ride the same substrate the reference exposes as its sample-sort
+scatter (sort.jl:24-55 → lax.all_to_all).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import layout as L
+from ..darray import DArray, _wrap_global
+from ..parallel.collectives import run_spmd
+
+__all__ = ["ulysses_attention"]
+
+
+def _dense_attention(q, k, v, causal, scale):
+    # q,k,v: (S, h_local, d) with FULL sequence — O(S^2) fallback
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        S = q.shape[0]
+        qi = jnp.arange(S)[:, None]
+        ki = jnp.arange(S)[None, :]
+        s = jnp.where((ki <= qi)[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def _flash_block(S: int) -> int:
+    """Largest power-of-two divisor of S, capped at 128."""
+    b = 1
+    while b < 128 and S % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+@functools.lru_cache(maxsize=32)
+def _ulysses_jit(mesh, causal: bool, scale: float, use_flash: bool):
+    axis = mesh.axis_names[0]
+
+    def kernel(q, k, v):
+        # in: (S/P, H, d) sequence-sharded blocks
+        def to_heads(x):
+            # all_to_all: gather full sequence, scatter heads
+            # (S/P, H, d) -> (S, H/P, d)
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=0,
+                                  tiled=True)
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+        if use_flash:
+            # per-rank compute = the Pallas flash kernel: no O(S^2) score
+            # matrix, VMEM-resident online softmax
+            from ..ops.pallas_attention import flash_attention
+            b = _flash_block(qh.shape[0])
+            oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                 block_q=b, block_k=b)
+        else:
+            oh = _dense_attention(qh, kh, vh, causal, scale)
+        # inverse: scatter sequence, gather heads: (S, H/P, d) -> (S/P, H, d)
+        return lax.all_to_all(oh, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+    spec = P(axis, None, None)
+    return run_spmd(kernel, mesh, in_specs=(spec,) * 3, out_specs=spec)
+
+
+def ulysses_attention(q: DArray, k: DArray, v: DArray,
+                      causal: bool = False,
+                      use_flash: bool = True) -> DArray:
+    """Exact attention over sequence-sharded (seq, heads, d) DArrays via
+    head-scatter all_to_all.  Requires heads divisible by the rank count.
+
+    Per-rank compute defaults to the Pallas flash kernel (O(seq·d) memory);
+    ``use_flash=False`` selects the dense O(seq²) jnp path."""
+    for name, a in (("q", q), ("k", k), ("v", v)):
+        if a.ndim != 3:
+            raise ValueError(f"{name} must be (seq, heads, head_dim), "
+                             f"got {a.dims}")
+        if a.dims != q.dims:
+            raise ValueError("q, k, v dims must match")
+    pids = [int(p) for p in q.pids.flat]
+    n = len(pids)
+    S, H, D = q.dims
+    if q.pids.shape[0] != n or S % n:
+        raise ValueError(
+            f"ulysses needs the sequence dim sharded evenly over a 1-D "
+            f"grid; got grid {q.pids.shape} for dims {q.dims}")
+    if H % n:
+        raise ValueError(f"heads {H} must be divisible by {n} ranks")
+    mesh = L.mesh_for(pids, (n, 1, 1))
+    scale = float(1.0 / np.sqrt(D))
+    out = _ulysses_jit(mesh, bool(causal), scale, bool(use_flash))(
+        q.garray, k.garray, v.garray)
+    return _wrap_global(out, procs=pids, dist=[n, 1, 1])

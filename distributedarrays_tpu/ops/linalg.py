@@ -158,10 +158,13 @@ DArray.T = property(dtranspose)
 
 
 @functools.lru_cache(maxsize=None)
-def _matmul_jit(out_sharding, alpha_beta: bool):
-    if alpha_beta:
+def _matmul_jit(out_sharding, mode: str):
+    if mode == "ab":            # alpha*A@B + beta*C
         def fn(a, b, c, alpha, beta):
             return alpha * jnp.matmul(a, b) + beta * c
+    elif mode == "alpha":       # fused alpha*A@B (no extra HBM pass)
+        def fn(a, b, alpha):
+            return alpha * jnp.matmul(a, b)
     else:
         def fn(a, b):
             return jnp.matmul(a, b)
@@ -221,35 +224,43 @@ def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
                 "mul_into: out's row cuts must equal A's row cuts "
                 "(reference linalg.jl:201)")
         C = out
+        out_dtype = C.dtype
+        sharding = C.sharding
+        procs = [int(p) for p in C.pids.flat]
+        dist = list(C.pids.shape)
     else:
+        # no zero-fill allocation: derive the result layout/sharding and
+        # wrap the matmul output directly
+        C = None
+        out_dtype = np.result_type(A.dtype, bv.dtype)
         if vec:
             procs = [int(p) for p in A.pids.flat]
-            C = _alloc_result((m,), procs, (A.pids.shape[0],),
-                              np.result_type(A.dtype, bv.dtype))
+            dist = [A.pids.shape[0]]
         else:
             procs, dist = _gemm_layout(A, B)
-            C = _alloc_result((m, n), procs, dist,
-                              np.result_type(A.dtype, bv.dtype))
+            dist = list(dist)
+        sharding = L.sharding_for(procs, dist, (m,) if vec else (m, n))
 
-    sharding = C.sharding
     from .broadcast import _align_devices
     av, bv = _align_devices([A.garray, bv], sharding)
     use_ab = not (alpha == 1.0 and beta == 0.0)
-    if use_ab:
-        res = _matmul_jit(sharding, True)(
+    if use_ab and C is not None:
+        res = _matmul_jit(sharding, "ab")(
             av, bv, C.garray,
-            jnp.asarray(alpha, C.dtype), jnp.asarray(beta, C.dtype))
+            jnp.asarray(alpha, out_dtype), jnp.asarray(beta, out_dtype))
+    elif beta != 0.0:
+        raise ValueError("beta accumulation requires out=")
+    elif alpha != 1.0:
+        res = _matmul_jit(sharding, "alpha")(
+            av, bv, jnp.asarray(alpha, out_dtype))
     else:
-        res = _matmul_jit(sharding, False)(av, bv)
-    if res.dtype != C.dtype:
-        res = res.astype(C.dtype)
-    C._rebind(res)
-    return C
-
-
-def _alloc_result(dims, procs, dist, dtype):
-    from ..darray import dzeros
-    return dzeros(dims, dtype=dtype, procs=procs, dist=dist)
+        res = _matmul_jit(sharding, "plain")(av, bv)
+    if res.dtype != out_dtype:
+        res = res.astype(out_dtype)
+    if C is not None:
+        C._rebind(res)
+        return C
+    return _wrap_global(res, procs=procs, dist=dist)
 
 
 def mul_into(C: DArray, A, B, alpha=1.0, beta=0.0) -> DArray:

@@ -46,6 +46,45 @@ def test_result_stays_sequence_sharded(qkv):
     assert out.cuts[0] == dq.cuts[0]
 
 
+@pytest.fixture
+def qkv8(rng):
+    # 8 heads so both ring and ulysses (heads % ranks == 0) apply
+    S, H, D = 64, 8, 16
+    mk = lambda: rng.standard_normal((S, H, D)).astype(np.float32)
+    q, k, v = mk(), mk(), mk()
+    dist = (8, 1, 1)
+    return (q, k, v,
+            dat.distribute(q, procs=range(8), dist=dist),
+            dat.distribute(k, procs=range(8), dist=dist),
+            dat.distribute(v, procs=range(8), dist=dist))
+
+
+def test_ulysses_matches_dense(qkv8):
+    from distributedarrays_tpu.models.ulysses import ulysses_attention
+    q, k, v, dq, dk, dv = qkv8
+    for causal in (False, True):
+        for use_flash in (True, False):   # pallas per-rank kernel + fallback
+            got = np.asarray(ulysses_attention(dq, dk, dv, causal=causal,
+                                               use_flash=use_flash))
+            want = RA.reference_attention(q, k, v, causal=causal)
+            assert np.abs(got - want).max() < 1e-5, (causal, use_flash)
+
+
+def test_ulysses_agrees_with_ring(qkv8):
+    from distributedarrays_tpu.models.ulysses import ulysses_attention
+    _, _, _, dq, dk, dv = qkv8
+    a = np.asarray(RA.ring_attention(dq, dk, dv, causal=True))
+    b = np.asarray(ulysses_attention(dq, dk, dv, causal=True))
+    assert np.abs(a - b).max() < 1e-5
+
+
+def test_ulysses_head_divisibility():
+    from distributedarrays_tpu.models.ulysses import ulysses_attention
+    bad = dat.dzeros((64, 6, 16), procs=range(8), dist=(8, 1, 1))
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(bad, bad, bad)
+
+
 def test_shape_validation(qkv):
     _, _, _, dq, dk, _ = qkv
     with pytest.raises(ValueError, match="dims must match"):
