@@ -194,6 +194,49 @@ def main():
     details["stencil_8192_step_marginal_s"] = t_st
     details["stencil_8192_gcells_per_s"] = rows * M / t_st / 1e9
 
+    # ---- extra: Pallas flash attention at long context -------------------
+    try:
+        from distributedarrays_tpu.ops.pallas_attention import flash_attention
+        SQ, HQ, DQ = 8192, 8, 64
+        q = jax.random.normal(jax.random.key(1), (SQ, HQ, DQ), jnp.bfloat16)
+
+        def fa_len(L):
+            def f():
+                def body(x, _):
+                    return flash_attention(x, q, q, causal=True), None
+                x, _ = lax.scan(body, q, None, length=L)
+                return jnp.sum(x.astype(jnp.float32))
+            jf = jax.jit(f)
+            float(jf())
+            return min(_t(lambda: float(jf())) for _ in range(2))
+
+        t_fa = _marginal(fa_len, L0=4, min_delta=0.05)
+        # causal flash: ~2*S^2*D*H flops (QK^T + PV), halved by causality
+        flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
+        details["flash_attn_8k_bf16_marginal_s"] = t_fa
+        details["flash_attn_8k_bf16_tflops"] = flops / t_fa / 1e12
+    except Exception as e:  # pragma: no cover
+        details["flash_attn_error"] = f"{type(e).__name__}: {e}"
+
+    # ---- extra: distributed sort over 1e7 elements -----------------------
+    try:
+        from distributedarrays_tpu.ops.sort import dsort
+        VS = dat.drand((10_000_000,))
+
+        def sort_once():
+            s = dsort(VS)
+            # force completion with a scalar fetch (tunnel caveat above)
+            v = float(s.garray[-1])
+            s.close()
+            return v
+
+        sort_once()                       # compile
+        t_sort = min(_t(sort_once) for _ in range(2))
+        details["sort_1e7_s"] = t_sort
+        details["sort_1e7_melem_per_s"] = 1e7 / t_sort / 1e6
+    except Exception as e:  # pragma: no cover
+        details["sort_error"] = f"{type(e).__name__}: {e}"
+
     dat.d_closeall()
 
     Path(__file__).with_name("BENCH_DETAILS.json").write_text(
