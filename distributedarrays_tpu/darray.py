@@ -216,6 +216,19 @@ class DArray:
             a = a.astype(dtype, copy=False)
         return a
 
+    # NOTE: deliberately NOT defining __jax_array__ — pytree registration
+    # (below) already lets DArrays enter jnp ops and transforms at jit
+    # boundaries, and __jax_array__ would additionally hijack reflected
+    # operators (jax.Array + DArray would stop deferring to __radd__).
+
+    def __bool__(self):
+        # numpy/Julia semantics: only size-1 arrays have a truth value
+        if self.size != 1:
+            raise ValueError(
+                "truth value of a multi-element DArray is ambiguous; use "
+                "dall()/dany()")
+        return bool(np.asarray(self).reshape(()))
+
     def __iter__(self):
         # iterating gathers — guard like scalar indexing
         _scalar_indexing_allowed()
@@ -603,6 +616,17 @@ def _result_shape(key, dims):
 # ---------------------------------------------------------------------------
 
 
+def _idxs_from_cuts(cuts, grid) -> np.ndarray:
+    """Object grid of per-chunk global index-range tuples derived from the
+    cut vectors (shared by from_chunks / darray_from_cuts / pytree
+    unflatten)."""
+    idxs = np.empty(tuple(grid), dtype=object)
+    for ci in np.ndindex(*grid):
+        idxs[ci] = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1])
+                         for d in range(len(cuts)))
+    return idxs
+
+
 def _resolve_layout(dims, procs=None, dist=None):
     dims = tuple(int(d) for d in dims)
     if procs is None:
@@ -724,15 +748,11 @@ def from_chunks(chunks: np.ndarray, procs=None) -> DArray:
         procs = L.all_ranks()
     n = int(np.prod(grid)) if grid else 1
     pids = np.asarray(procs[:n], dtype=np.int64).reshape(grid)
-    idxs = np.empty(grid, dtype=object)
+    idxs = _idxs_from_cuts(cuts, grid)
     dtype = np.result_type(*[np.asarray(chunks[ci]).dtype
                              for ci in np.ndindex(*grid)])
-    parts, idxs_list = [], []
-    for ci in np.ndindex(*grid):
-        rngs = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1]) for d in range(nd))
-        idxs[ci] = rngs
-        parts.append(np.asarray(chunks[ci], dtype=dtype))
-        idxs_list.append(rngs)
+    parts = [np.asarray(chunks[ci], dtype=dtype) for ci in np.ndindex(*grid)]
+    idxs_list = [idxs[ci] for ci in np.ndindex(*grid)]
     host = _assemble_host(dims, dtype, parts, idxs_list)
     sharding = L.sharding_for(list(pids.flat), grid, dims)
     return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
@@ -754,10 +774,7 @@ def darray_from_cuts(host, procs, cuts) -> DArray:
         raise ValueError(f"layout {grid} needs {n} ranks, got {len(procs)}")
     use = procs[:n]
     pids = np.asarray(use, dtype=np.int64).reshape(grid)
-    idxs = np.empty(grid, dtype=object)
-    for ci in np.ndindex(*grid):
-        idxs[ci] = tuple(range(cuts[d][ci[d]], cuts[d][ci[d] + 1])
-                         for d in range(len(dims)))
+    idxs = _idxs_from_cuts(cuts, grid)
     # physical sharding follows the same dims-divisibility rule as every
     # other constructor (L.sharding_for): logical cuts may be uneven while
     # the physical layout stays sharded wherever XLA allows
@@ -952,6 +969,47 @@ def ddata(*, init: Callable | None = None, pids: Sequence[int] | None = None,
         for p in pids:
             parts[p] = None
     return DData(parts, pids)
+
+
+# ---------------------------------------------------------------------------
+# pytree registration: DArrays drop into any JAX transform (jit/grad/vmap,
+# jnp ops).  Flatten yields the sharded global array; unflatten rebuilds the
+# wrapper for concrete arrays and passes tracers straight through, so inside
+# a traced function a DArray argument simply *is* its global array.
+# ---------------------------------------------------------------------------
+
+
+def _darray_flatten(d: DArray):
+    aux = (tuple(tuple(c) for c in d.cuts), tuple(d.pids.shape),
+           tuple(int(p) for p in d.pids.flat))
+    return (d.garray,), aux
+
+
+def _darray_unflatten(aux, children):
+    data, = children
+    if not isinstance(data, jax.Array) or isinstance(data, jax.core.Tracer):
+        # inside a transform: behave as the raw (traced) global array
+        return data
+    cuts, grid, pids_flat = aux
+    if tuple(data.shape) != tuple(c[-1] for c in cuts):
+        # shape changed under the transform (e.g. vmap/reduction output):
+        # hand back the plain array rather than a mislabeled DArray
+        return data
+    try:
+        expect = L.sharding_for(list(pids_flat), grid, tuple(data.shape))
+        if data.sharding != expect:
+            # device placement diverged from the recorded layout (e.g. a
+            # device_put inside the transform): a DArray whose metadata
+            # contradicts reality is worse than a plain array
+            return data
+    except Exception:
+        return data
+    pids = np.asarray(pids_flat, dtype=np.int64).reshape(grid)
+    return DArray(data, pids, _idxs_from_cuts(cuts, grid),
+                  [list(c) for c in cuts])
+
+
+jax.tree_util.register_pytree_node(DArray, _darray_flatten, _darray_unflatten)
 
 
 def copyto_(dest, src) -> "DArray":

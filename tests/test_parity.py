@@ -118,6 +118,71 @@ def test_zero_size_dim_ops():
     assert d.dims == (0, 4)
 
 
+def test_jax_array_protocol(rng):
+    # DArrays drop directly into jnp ops / jitted functions
+    import jax
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    B = rng.standard_normal((8, 12)).astype(np.float32)
+    da, db = dat.distribute(A), dat.distribute(B)
+    r = jnp.sin(da)
+    assert isinstance(r, jnp.ndarray)
+    assert np.allclose(np.asarray(r), np.sin(A), rtol=1e-5)
+    m = jnp.matmul(da, db)
+    assert np.allclose(np.asarray(m), A @ B, rtol=1e-4, atol=1e-5)
+    jitted = jax.jit(lambda x: (x * 2).sum())
+    assert np.allclose(float(jitted(da)), 2 * A.sum(), rtol=1e-4)
+
+
+def test_reflected_operators_stay_darray(rng):
+    # regression: jax.Array on the LEFT must defer to DArray.__radd__ etc.
+    # (__jax_array__ would hijack this — deliberately not defined)
+    A = rng.standard_normal((8, 4)).astype(np.float32)
+    d = dat.distribute(A)
+    j = jnp.asarray(A)
+    r = j + d
+    assert isinstance(r, dat.DArray)
+    assert np.allclose(np.asarray(r), 2 * A, rtol=1e-6)
+    m = jnp.asarray(A) @ dat.distribute(rng.standard_normal((4, 3)).astype(np.float32))
+    assert isinstance(m, dat.DArray)
+
+
+def test_unflatten_sharding_mismatch_degrades():
+    # tree_map that moves the leaf to one device diverges placement from
+    # the recorded layout: unflatten must degrade to a plain array, not a
+    # DArray whose metadata lies about distribution
+    import jax
+    d = dat.dzeros((16, 8), procs=range(8), dist=(8, 1))
+    out = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, jax.devices()[0]), d)
+    assert not isinstance(out, dat.DArray)
+    # identity tree_map keeps placement → full DArray reconstruction
+    same = jax.tree_util.tree_map(lambda x: x, d)
+    assert isinstance(same, dat.DArray)
+    assert same.cuts == d.cuts
+
+
+def test_bool_semantics():
+    with pytest.raises(ValueError, match="ambiguous"):
+        bool(dat.dzeros((4,)))
+    assert bool(dat.dfill(1.0, (1,))) is True
+    assert bool(dat.dzeros((1,))) is False
+
+
+def test_matmul_property(rng):
+    # random GEMM shapes across random layouts vs numpy
+    for _ in range(6):
+        m, k, n = (int(rng.integers(1, 40)) for _ in range(3))
+        A = rng.standard_normal((m, k)).astype(np.float32)
+        B = rng.standard_normal((k, n)).astype(np.float32)
+        g0 = int(rng.integers(1, 5))
+        g1 = max(1, 8 // g0)
+        da = dat.distribute(A, procs=range(8), dist=(min(g0, m), 1))
+        db = dat.distribute(B, procs=range(8), dist=(1, min(g1, n)))
+        C = da @ db
+        assert np.allclose(np.asarray(C), A @ B, rtol=1e-4, atol=1e-4), \
+            (m, k, n, g0, g1)
+
+
 def test_deepcopy_memo_aliasing(rng):
     import copy as pycopy
     d = dat.distribute(rng.standard_normal((8, 8)).astype(np.float32))
