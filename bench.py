@@ -76,6 +76,11 @@ def _device_watchdog(timeout_s: float = 480.0) -> bool:
     return result
 
 
+def _save(details):
+    Path(__file__).with_name("BENCH_DETAILS.json").write_text(
+        json.dumps(details, indent=2))
+
+
 def main():
     probe = _device_watchdog()
     if not probe["ok"]:
@@ -114,16 +119,18 @@ def main():
             return min(_t(lambda: float(f(A, B))) for _ in range(3))
         return gemm_chain
 
-    # headline: true float32 (precision=HIGHEST) — apples-to-apples with the
-    # f32 CPU BLAS baseline; TPU-native mixed precision recorded alongside
-    t_gemm = _marginal(gemm_chain_at(jax.lax.Precision.HIGHEST), L0=50)
+    # headline: DEFAULT precision (the TPU-native mixed bf16-pass matmul,
+    # labeled as such).  A previous session observed the remote-compile
+    # service wedge while compiling a HIGHEST-precision scan, so the true-
+    # f32 measurement is attempted LAST (see end of main) under a timeout,
+    # after every other number is already banked.
+    t_gemm = _marginal(gemm_chain_at(jax.lax.Precision.DEFAULT), L0=50)
     gflops = 2 * N**3 / t_gemm / 1e9
-    t_gemm_bf16 = _marginal(gemm_chain_at(jax.lax.Precision.DEFAULT), L0=50)
-    details["gemm_4096_f32_marginal_s"] = t_gemm
-    details["gemm_4096_f32_gflops"] = gflops
-    details["gemm_4096_mixed_bf16pass_gflops"] = 2 * N**3 / t_gemm_bf16 / 1e9
+    details["gemm_4096_mixed_bf16pass_marginal_s"] = t_gemm
+    details["gemm_4096_mixed_bf16pass_gflops"] = gflops
     (A @ B).garray                         # compile the eager path
     details["gemm_4096_f32_eager_latency_s"] = _t(lambda: (A @ B).garray)
+    _save(details)
 
     # sum(A.^2) half of config 0
     float(dat.dmapreduce(jnp.square, "sum", A))
@@ -136,6 +143,7 @@ def main():
     t_np = min(_t(lambda: an @ bn) for _ in range(2))
     cpu_gflops = 2 * N**3 / t_np / 1e9
     details["cpu_numpy_gflops"] = cpu_gflops
+    _save(details)
 
     # ---- config 1: broadcast chain sin.(A) .+ B .* C on 8192^2 ----------
     M = 8192
@@ -154,6 +162,7 @@ def main():
     t_chain = _marginal(chain_chain, L0=20)
     details["broadcast_chain_8192_marginal_s"] = t_chain
     details["broadcast_chain_8192_gbps"] = 4 * M * M * 4 / t_chain / 1e9
+    _save(details)
 
     # ---- config 2: mapreduce(abs2,+) and mean/std over 1e8 --------------
     V = dat.drand((100_000_000,))
@@ -175,6 +184,7 @@ def main():
     float(dat.dmean(V)); float(dat.dstd(V))
     details["mean_std_1e8_eager_s"] = _t(
         lambda: (float(dat.dmean(V)), float(dat.dstd(V))))
+    _save(details)
 
     # ---- config 4: stencil halo exchange on 8192^2 -----------------------
     rows = (M // ndev) * ndev
@@ -193,6 +203,7 @@ def main():
     t_st = _marginal(st_len, L0=10)
     details["stencil_8192_step_marginal_s"] = t_st
     details["stencil_8192_gcells_per_s"] = rows * M / t_st / 1e9
+    _save(details)
 
     # ---- extra: Pallas flash attention at long context -------------------
     try:
@@ -217,6 +228,7 @@ def main():
         details["flash_attn_8k_bf16_tflops"] = flops / t_fa / 1e12
     except Exception as e:  # pragma: no cover
         details["flash_attn_error"] = f"{type(e).__name__}: {e}"
+    _save(details)
 
     # ---- extra: distributed sort over 1e7 elements -----------------------
     try:
@@ -236,14 +248,36 @@ def main():
         details["sort_1e7_melem_per_s"] = 1e7 / t_sort / 1e6
     except Exception as e:  # pragma: no cover
         details["sort_error"] = f"{type(e).__name__}: {e}"
+    _save(details)
 
-    dat.d_closeall()
+    # ---- last (riskiest): true-f32 GEMM (precision=HIGHEST) --------------
+    # attempted after everything is banked, under a thread timeout: a
+    # wedged remote compile must not cost the run its other numbers
+    import threading
 
-    Path(__file__).with_name("BENCH_DETAILS.json").write_text(
-        json.dumps(details, indent=2))
+    def highest():
+        try:
+            t = _marginal(gemm_chain_at(jax.lax.Precision.HIGHEST), L0=50)
+            details["gemm_4096_f32_highest_marginal_s"] = t
+            details["gemm_4096_f32_highest_gflops"] = 2 * N**3 / t / 1e9
+        except Exception as e:  # pragma: no cover
+            details["gemm_f32_highest_error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=highest, daemon=True)
+    th.start()
+    th.join(600)
+    if th.is_alive():
+        details["gemm_f32_highest_error"] = "timed out (remote compile hang)"
+
+    try:
+        dat.d_closeall()
+    except Exception:
+        pass
+
+    _save(details)
 
     print(json.dumps({
-        "metric": "gemm_4096_f32_gflops",
+        "metric": "gemm_4096_gflops_mixed_precision_bf16pass",
         "value": round(gflops, 2),
         "unit": "GFLOPS",
         "vs_baseline": round(gflops / cpu_gflops, 2),
