@@ -16,7 +16,7 @@ from .core import (allowscalar, close, d_closeall, next_did, procs, registry,
                    live_ids, current_rank)
 from .darray import (DArray, SubDArray, SubOrDArray, DData, darray,
                      darray_like, from_chunks, dzeros, dones, dfill, drand,
-                     drandn, distribute, ddata, gather, localpart,
+                     drandint, dsample, drandn, distribute, ddata, gather, localpart,
                      localindices, locate, makelocal, seed, copyto_, dcat,
                      dfetch)
 from .layout import (defaultdist, defaultdist_1d, chunk_idxs, mesh_for,

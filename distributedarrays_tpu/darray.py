@@ -47,6 +47,8 @@ __all__ = [
     "dones",
     "dfill",
     "drand",
+    "drandint",
+    "dsample",
     "drandn",
     "distribute",
     "ddata",
@@ -814,6 +816,42 @@ def drand(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
     dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
     data = _filler("rand", dims, np.dtype(dtype), sh)(_next_key())
     return DArray(data, pids, idxs, cuts)
+
+
+def drandint(low, high, dims, dtype=jnp.int32, procs=None, dist=None
+             ) -> DArray:
+    """Distributed uniform integers in [low, high) — the reference's
+    ``drand(r::UnitRange, dims)`` form (test/darray.jl:641-647)."""
+    dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
+    data = _randint_filler(dims, int(low), int(high), np.dtype(dtype),
+                           sh)(_next_key())
+    return DArray(data, pids, idxs, cuts)
+
+
+@functools.lru_cache(maxsize=None)
+def _randint_filler(dims, low, high, dtype, sharding):
+    fn = lambda key: jax.random.randint(key, dims, low, high, dtype=dtype)
+    return jax.jit(fn, out_shardings=sharding)
+
+
+def dsample(values, dims, procs=None, dist=None) -> DArray:
+    """Distributed draws from an explicit value set — the reference's
+    ``drand(arr::Array, dims)`` form (test/darray.jl:648-654)."""
+    values = jnp.ravel(jnp.asarray(values))
+    if values.shape[0] == 0:
+        raise ValueError("dsample: empty value set")
+    dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
+    data = _sample_filler(dims, int(values.shape[0]),
+                          np.dtype(values.dtype), sh)(_next_key(), values)
+    return DArray(data, pids, idxs, cuts)
+
+
+@functools.lru_cache(maxsize=None)
+def _sample_filler(dims, nvals, dtype, sharding):
+    def fn(key, values):
+        idx = jax.random.randint(key, dims, 0, nvals)
+        return values[idx]
+    return jax.jit(fn, out_shardings=sharding)
 
 
 def drandn(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:

@@ -218,6 +218,7 @@ _BINOPS = {
     "__mod__": jnp.mod, "__pow__": jnp.power,
     "__and__": jnp.bitwise_and, "__or__": jnp.bitwise_or,
     "__xor__": jnp.bitwise_xor,
+    "__lshift__": jnp.left_shift, "__rshift__": jnp.right_shift,
     "__lt__": jnp.less, "__le__": jnp.less_equal,
     "__gt__": jnp.greater, "__ge__": jnp.greater_equal,
 }
@@ -228,6 +229,7 @@ _RBINOPS = {
     "__rmod__": jnp.mod, "__rpow__": jnp.power,
     "__rand__": jnp.bitwise_and, "__ror__": jnp.bitwise_or,
     "__rxor__": jnp.bitwise_xor,
+    "__rlshift__": jnp.left_shift, "__rrshift__": jnp.right_shift,
 }
 
 for cls in (DArray, SubDArray):

@@ -35,6 +35,39 @@ def test_drand_drandn_deterministic():
     assert abs(n.mean()) < 0.2
 
 
+def test_drandint_dsample():
+    # reference drand(r::UnitRange, dims) / drand(arr, dims)
+    # (test/darray.jl:641-654)
+    dat.seed(5)
+    d = dat.drandint(3, 9, (64, 8))
+    a = np.asarray(d)
+    assert a.min() >= 3 and a.max() < 9
+    assert jnp.issubdtype(d.dtype, jnp.integer)
+    vals = np.array([2.5, -1.0, 7.25], np.float32)
+    s = dat.dsample(vals, (256,))
+    sa = np.asarray(s)
+    assert set(np.unique(sa)).issubset(set(vals.tolist()))
+    assert len(np.unique(sa)) == 3
+
+
+def test_collections_api(rng):
+    # reference "collections API": length / lastindex (test/darray.jl:423-436)
+    A = rng.standard_normal((20, 4)).astype(np.float32)
+    d = dat.distribute(A)
+    assert len(d) == 20
+    assert d.size == 80
+    with dat.allowscalar(True):
+        assert float(d[-1, -1]) == A[-1, -1]       # lastindex analog
+
+
+def test_shift_operators():
+    i = dat.distribute(np.arange(1, 17, dtype=np.int32))
+    l = i << 2
+    r = i >> 1
+    assert np.array_equal(np.asarray(l), np.arange(1, 17) << 2)
+    assert np.array_equal(np.asarray(r), np.arange(1, 17) >> 1)
+
+
 def test_distribute_roundtrip(rng):
     A = rng.standard_normal((40, 24)).astype(np.float32)
     d = dat.distribute(A)

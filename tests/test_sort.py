@@ -65,6 +65,14 @@ def test_sort_sample_kwarg_parity(rng):
         assert np.array_equal(np.asarray(s), np.sort(x))
 
 
+def test_sort_tiny_sizes(rng):
+    # reference sweeps sort over 10^0..10^6 elements (test/darray.jl:1015)
+    for n in (1, 2, 7, 10, 100):
+        x = rng.standard_normal(n).astype(np.float32)
+        s = dsort(dat.distribute(x))
+        assert np.array_equal(np.asarray(s), np.sort(x)), n
+
+
 def test_sort_2d_raises(rng):
     with pytest.raises(ValueError):
         dsort(dat.dzeros((4, 4)))
