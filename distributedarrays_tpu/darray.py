@@ -563,6 +563,41 @@ class SubDArray:
         return f"SubDArray(parent={self.parent.id}, key={self.key}, shape={self.shape})"
 
 
+# ---------------------------------------------------------------------------
+# numpy-style reduction methods, wired onto BOTH DArray and SubDArray (like
+# the operator surface).  Semantics follow the reference/Julia, not numpy:
+# `dims=` reductions KEEP reduced dims with size 1, and std/var default to
+# the corrected estimator (ddof=1).
+# ---------------------------------------------------------------------------
+
+
+def _method_reduce(name, doc):
+    def m(self, dims=None, **kw):
+        from .ops import mapreduce as _mr
+        return getattr(_mr, name)(self, dims=dims, **kw)
+    m.__name__ = name.lstrip("d")
+    m.__doc__ = doc
+    return m
+
+
+_REDUCE_METHODS = {
+    "sum": ("dsum", "Distributed sum; `dims=` keeps reduced dims (size 1)."),
+    "mean": ("dmean", "Distributed mean; `dims=` keeps reduced dims."),
+    "std": ("dstd", "Corrected std (ddof=1 default, Julia semantics)."),
+    "var": ("dvar", "Corrected variance (ddof=1 default, Julia semantics)."),
+    "min": ("dminimum", "Distributed minimum; `dims=` keeps reduced dims."),
+    "max": ("dmaximum", "Distributed maximum; `dims=` keeps reduced dims."),
+    "prod": ("dprod", "Distributed product; `dims=` keeps reduced dims."),
+    "all": ("dall", "True iff every element is truthy."),
+    "any": ("dany", "True iff any element is truthy."),
+}
+
+for _mname, (_fname, _doc) in _REDUCE_METHODS.items():
+    _m = _method_reduce(_fname, _doc)
+    setattr(DArray, _mname, _m)
+    setattr(SubDArray, _mname, _m)
+
+
 SubOrDArray = (DArray, SubDArray)
 
 
@@ -823,14 +858,16 @@ def drandint(low, high, dims, dtype=jnp.int32, procs=None, dist=None
     """Distributed uniform integers in [low, high) — the reference's
     ``drand(r::UnitRange, dims)`` form (test/darray.jl:641-647)."""
     dims, pids, idxs, cuts, sh = _resolve_layout(_as_dims(dims), procs, dist)
-    data = _randint_filler(dims, int(low), int(high), np.dtype(dtype),
-                           sh)(_next_key())
+    data = _randint_filler(dims, np.dtype(dtype), sh)(
+        _next_key(), jnp.asarray(int(low)), jnp.asarray(int(high)))
     return DArray(data, pids, idxs, cuts)
 
 
 @functools.lru_cache(maxsize=None)
-def _randint_filler(dims, low, high, dtype, sharding):
-    fn = lambda key: jax.random.randint(key, dims, low, high, dtype=dtype)
+def _randint_filler(dims, dtype, sharding):
+    # low/high ride as traced args so varying bounds reuse one executable
+    fn = lambda key, lo, hi: jax.random.randint(key, dims, lo, hi,
+                                                dtype=dtype)
     return jax.jit(fn, out_shardings=sharding)
 
 

@@ -138,15 +138,46 @@ def test_djit_multiple_outputs(abc):
 
 def test_many_scalar_functions(abc):
     # reference test/darray.jl:778-791 runs ~70 scalar functions through
-    # broadcast; representative sample here
+    # broadcast; the jnp-available equivalents, domain-partitioned
     A, _, _ = abc
-    d = dat.distribute(np.abs(A) + 0.5)
-    for jf, nf in [(jnp.sin, np.sin), (jnp.cos, np.cos), (jnp.exp, np.exp),
-                   (jnp.log, np.log), (jnp.sqrt, np.sqrt),
-                   (jnp.tanh, np.tanh), (jnp.floor, np.floor),
-                   (jnp.ceil, np.ceil), (jnp.sign, np.sign),
-                   (jnp.arctan, np.arctan), (jnp.log1p, np.log1p),
-                   (jnp.expm1, np.expm1), (jnp.cbrt, np.cbrt)]:
-        got = dat.dmap(jf, d)
-        want = nf(np.asarray(d))
-        assert np.allclose(np.asarray(got), want, rtol=1e-5, atol=1e-6), jf
+    pos = dat.distribute(np.abs(A) + 0.5)            # (0.5, inf)
+    anyv = dat.distribute(A)                          # (-inf, inf)
+    unit = dat.distribute(np.tanh(A) * 0.99)          # (-1, 1)
+    cases = {
+        pos: [(jnp.log, np.log), (jnp.sqrt, np.sqrt), (jnp.log1p, np.log1p),
+              (jnp.log2, np.log2), (jnp.log10, np.log10),
+              (jnp.reciprocal, np.reciprocal)],
+        anyv: [(jnp.sin, np.sin), (jnp.cos, np.cos), (jnp.tan, np.tan),
+               (jnp.exp, np.exp), (jnp.tanh, np.tanh), (jnp.sinh, np.sinh),
+               (jnp.cosh, np.cosh), (jnp.floor, np.floor),
+               (jnp.ceil, np.ceil), (jnp.trunc, np.trunc),
+               (jnp.rint, np.rint), (jnp.sign, np.sign),
+               (jnp.arctan, np.arctan), (jnp.arcsinh, np.arcsinh),
+               (jnp.expm1, np.expm1), (jnp.cbrt, np.cbrt),
+               (jnp.exp2, np.exp2), (jnp.square, np.square),
+               (jnp.deg2rad, np.deg2rad), (jnp.rad2deg, np.rad2deg),
+               (jnp.abs, np.abs)],
+        unit: [(jnp.arcsin, np.arcsin), (jnp.arccos, np.arccos),
+               (jnp.arctanh, np.arctanh)],
+    }
+    for d, fns in cases.items():
+        host = np.asarray(d)
+        for jf, nf in fns:
+            got = dat.dmap(jf, d)
+            want = nf(host)
+            assert np.allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-5), jf
+
+
+def test_reduction_methods(abc):
+    # numpy-style methods delegate to the distributed reductions
+    A, _, _ = abc
+    d = dat.distribute(A)
+    assert np.allclose(float(d.sum()), A.sum(), rtol=1e-4)
+    assert np.allclose(float(d.mean()), A.mean(), rtol=1e-5)
+    assert np.allclose(float(d.std()), A.std(ddof=1), rtol=1e-4)
+    assert np.allclose(float(d.min()), A.min())
+    assert np.allclose(float(d.max()), A.max())
+    r = d.sum(dims=0)
+    assert r.dims == (1, 24)
+    assert bool((d * 0 + 1).all())
