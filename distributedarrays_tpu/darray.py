@@ -571,29 +571,31 @@ class SubDArray:
 # ---------------------------------------------------------------------------
 
 
-def _method_reduce(name, doc):
+def _method_reduce(attr_name, fn_name, doc, defaults):
     def m(self, dims=None, **kw):
         from .ops import mapreduce as _mr
-        return getattr(_mr, name)(self, dims=dims, **kw)
-    m.__name__ = name.lstrip("d")
+        merged = {**defaults, **kw}
+        return getattr(_mr, fn_name)(self, dims=dims, **merged)
+    m.__name__ = attr_name
     m.__doc__ = doc
     return m
 
 
 _REDUCE_METHODS = {
-    "sum": ("dsum", "Distributed sum; `dims=` keeps reduced dims (size 1)."),
-    "mean": ("dmean", "Distributed mean; `dims=` keeps reduced dims."),
-    "std": ("dstd", "Corrected std (ddof=1 default, Julia semantics)."),
-    "var": ("dvar", "Corrected variance (ddof=1 default, Julia semantics)."),
-    "min": ("dminimum", "Distributed minimum; `dims=` keeps reduced dims."),
-    "max": ("dmaximum", "Distributed maximum; `dims=` keeps reduced dims."),
-    "prod": ("dprod", "Distributed product; `dims=` keeps reduced dims."),
-    "all": ("dall", "True iff every element is truthy."),
-    "any": ("dany", "True iff any element is truthy."),
+    "sum": ("dsum", "Distributed sum; `dims=` keeps reduced dims (size 1).", {}),
+    "mean": ("dmean", "Distributed mean; `dims=` keeps reduced dims.", {}),
+    "std": ("dstd", "Corrected std (ddof=1 default, Julia semantics).", {}),
+    "var": ("dvar", "Corrected variance (ddof=1 default, Julia semantics).",
+            {"ddof": 1}),
+    "min": ("dminimum", "Distributed minimum; `dims=` keeps reduced dims.", {}),
+    "max": ("dmaximum", "Distributed maximum; `dims=` keeps reduced dims.", {}),
+    "prod": ("dprod", "Distributed product; `dims=` keeps reduced dims.", {}),
+    "all": ("dall", "True iff every element is truthy.", {}),
+    "any": ("dany", "True iff any element is truthy.", {}),
 }
 
-for _mname, (_fname, _doc) in _REDUCE_METHODS.items():
-    _m = _method_reduce(_fname, _doc)
+for _mname, (_fname, _doc, _defaults) in _REDUCE_METHODS.items():
+    _m = _method_reduce(_mname, _fname, _doc, _defaults)
     setattr(DArray, _mname, _m)
     setattr(SubDArray, _mname, _m)
 

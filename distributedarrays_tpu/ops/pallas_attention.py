@@ -103,6 +103,48 @@ def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
     return jax.jit(call)
 
 
+def _dense_attention_shd(q, k, v, causal: bool, scale: float):
+    """Dense jnp attention with EXACTLY the kernel's semantics (f32 softmax,
+    (S, H, D) layout) — used as the differentiation rule for the kernel."""
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if causal:
+        S = q.shape[0]
+        qi = jnp.arange(S)[:, None]
+        ki = jnp.arange(S)[None, :]
+        s = jnp.where((ki <= qi)[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, bq, bk, interpret):
+    S, H, D = q.shape
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    out = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
+                 interpret)(qh, kh, vh)
+    return jnp.transpose(out, (1, 0, 2))
+
+
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    return _flash_core(q, k, v, causal, scale, bq, bk, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+    # backward differentiates the mathematically-identical dense form:
+    # exact gradients, O(S^2) memory in the backward only (the forward
+    # stays O(S·d)).  A Pallas backward kernel can replace this without
+    # touching callers.
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_attention_shd(
+        q_, k_, v_, causal, scale), q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool | None = None):
@@ -125,7 +167,4 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
     if interpret is None:
         interpret = not _on_tpu()
     sc = float(1.0 / np.sqrt(D) if scale is None else scale)
-    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
-    out = _build(H, S, D, bq, bk, str(q.dtype), sc, bool(causal),
-                 bool(interpret))(qh, kh, vh)
-    return jnp.transpose(out, (1, 0, 2))
+    return _flash_core(q, k, v, bool(causal), sc, bq, bk, bool(interpret))

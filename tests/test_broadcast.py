@@ -176,6 +176,9 @@ def test_reduction_methods(abc):
     assert np.allclose(float(d.sum()), A.sum(), rtol=1e-4)
     assert np.allclose(float(d.mean()), A.mean(), rtol=1e-5)
     assert np.allclose(float(d.std()), A.std(ddof=1), rtol=1e-4)
+    # var defaults corrected like std (regression: std^2 == var)
+    assert np.allclose(float(d.var()), A.var(ddof=1), rtol=1e-4)
+    assert np.allclose(float(d.std()) ** 2, float(d.var()), rtol=1e-4)
     assert np.allclose(float(d.min()), A.min())
     assert np.allclose(float(d.max()), A.max())
     r = d.sum(dims=0)

@@ -171,5 +171,5 @@ def test_graft_entry_points():
     import __graft_entry__ as g
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape == (64, 128)
+    assert out.shape == (8, 128, 256)    # (batch, seq, vocab) logits
     g.dryrun_multichip(8)
