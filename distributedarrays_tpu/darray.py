@@ -586,7 +586,7 @@ _REDUCE_METHODS = {
     "mean": ("dmean", "Distributed mean; `dims=` keeps reduced dims.", {}),
     "std": ("dstd", "Corrected std (ddof=1 default, Julia semantics).", {}),
     "var": ("dvar", "Corrected variance (ddof=1 default, Julia semantics).",
-            {"ddof": 1}),
+            {}),
     "min": ("dminimum", "Distributed minimum; `dims=` keeps reduced dims.", {}),
     "max": ("dmaximum", "Distributed maximum; `dims=` keeps reduced dims.", {}),
     "prod": ("dprod", "Distributed product; `dims=` keeps reduced dims.", {}),

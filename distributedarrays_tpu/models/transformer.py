@@ -65,7 +65,7 @@ def init_params(key, cfg: Config):
         return jax.random.normal(k, shape, dt) * jnp.asarray(
             np.sqrt(1.0 / fan_in), dt)
 
-    keys = iter(jax.random.split(key, 4 + 6 * cfg.layers))
+    keys = iter(jax.random.split(key, 3 + 4 * cfg.layers))
     params = {
         "embed": dense(next(keys), (cfg.vocab, E), E),
         "pos": dense(next(keys), (cfg.max_seq, E), E),
@@ -124,15 +124,25 @@ def _attention(x, blk, heads):
     qkv = x @ blk["qkv"]                                  # (B, S, 3E)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
-    def fold(t):
-        # (B, S, E) -> (S, B*heads, D): batch folds into the head axis so
-        # ONE flash-kernel call covers the whole batch (causality is
-        # per-head, so folding is exact)
-        return jnp.transpose(t.reshape(B, S, heads, D),
-                             (1, 0, 2, 3)).reshape(S, B * heads, D)
+    # pad the sequence to a healthy block multiple (tiny or odd S would
+    # force degenerate flash blocks); padded KEYS sit at positions >= S so
+    # the causal mask hides them from every real query row, and padded
+    # query rows are sliced away below
+    bs = min(128, 64 if S > 32 else 32)
+    Spad = -(-S // bs) * bs
 
-    o = flash_attention(fold(q), fold(k), fold(v), causal=True)
-    o = jnp.transpose(o.reshape(S, B, heads, D), (1, 0, 2, 3)).reshape(B, S, E)
+    def fold(t):
+        # (B, S, E) -> (Spad, B*heads, D): batch folds into the head axis
+        # so ONE flash-kernel call covers the whole batch (causality is
+        # per-head, so folding is exact)
+        t = jnp.pad(t, ((0, 0), (0, Spad - S), (0, 0)))
+        return jnp.transpose(t.reshape(B, Spad, heads, D),
+                             (1, 0, 2, 3)).reshape(Spad, B * heads, D)
+
+    o = flash_attention(fold(q), fold(k), fold(v), causal=True,
+                        block_q=bs, block_k=bs)
+    o = jnp.transpose(o.reshape(Spad, B, heads, D),
+                      (1, 0, 2, 3)).reshape(B, Spad, E)[:, :S]
     return o @ blk["proj"]
 
 

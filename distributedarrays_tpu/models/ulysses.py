@@ -31,26 +31,8 @@ from ..parallel.collectives import run_spmd
 __all__ = ["ulysses_attention"]
 
 
-def _dense_attention(q, k, v, causal, scale):
-    # q,k,v: (S, h_local, d) with FULL sequence — O(S^2) fallback
-    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32) * scale,
-                   k.astype(jnp.float32))
-    if causal:
-        S = q.shape[0]
-        qi = jnp.arange(S)[:, None]
-        ki = jnp.arange(S)[None, :]
-        s = jnp.where((ki <= qi)[None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
-    return o.astype(q.dtype)
-
-
-def _flash_block(S: int) -> int:
-    """Largest power-of-two divisor of S, capped at 128."""
-    b = 1
-    while b < 128 and S % (b * 2) == 0:
-        b *= 2
-    return b
+from ..ops.pallas_attention import (_dense_attention_shd as _dense_attention,
+                                    flash_block_size as _flash_block)
 
 
 @functools.lru_cache(maxsize=32)

@@ -128,9 +128,13 @@ dprod = _named("prod")
 dmaximum = _named("max")
 dminimum = _named("min")
 dmean = _named("mean")
-dvar = _named("var")
 dall = _named("all")
 dany = _named("any")
+
+
+def dvar(d, dims=None, ddof=1):
+    """Corrected (ddof=1) variance, matching Julia's Statistics.var default."""
+    return _reduce_impl(d, None, jnp.var, dims=dims, ddof=ddof)
 
 
 def dstd(d, dims=None, ddof=1):

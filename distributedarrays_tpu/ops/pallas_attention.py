@@ -11,10 +11,17 @@ persistence across the innermost sequential grid axis).
 
 Layout: grid ``(heads, S/bq, S/bk)`` with the K axis innermost; scratch
 ``m (bq,1)``, ``l (bq,1)``, ``acc (bq,d)`` persist across the K sweep for
-each (head, q-block) and flush to the output on the final K step.
-Causal masking compares global q/k positions derived from the grid ids.
+each (head, q-block) and flush to the output (and the per-row logsumexp)
+on the final K step.  Causal masking compares global q/k positions derived
+from the grid ids.
 
-Interpreter mode runs the same kernel off-TPU for the CPU-mesh test suite.
+Differentiable end to end with FlashAttention-2-style BACKWARD KERNELS
+(custom_vjp): the forward saves only O(S) logsumexp rows; the backward
+recomputes P blockwise and runs two Pallas passes — a K-sweep accumulating
+dQ and a Q-sweep accumulating dK/dV — so training memory stays O(S·d).
+Gradients match the dense formulation to ~1e-5 (tested).
+
+Interpreter mode runs the same kernels off-TPU for the CPU-mesh test suite.
 """
 
 from __future__ import annotations
@@ -34,10 +41,19 @@ except Exception:  # pragma: no cover
 
 from .pallas_gemm import _on_tpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_block_size"]
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def flash_block_size(S: int, cap: int = 128) -> int:
+    """Largest power-of-two divisor of ``S``, capped — a always-valid block
+    size for ``flash_attention`` (use when S is not a multiple of 128)."""
+    b = 1
+    while b < cap and S % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             scale: float, causal: bool, bq: int, bk: int, k_steps: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -74,6 +90,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _flush():
         l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        # per-row logsumexp, consumed by the backward kernels
+        m_fin = jnp.where(jnp.isfinite(m_ref[:]), m_ref[:], 0.0)
+        lse_ref[0] = (m_fin + jnp.log(l))[:, 0]
 
 
 @functools.lru_cache(maxsize=64)
@@ -91,8 +110,14 @@ def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, s, d), jnp.dtype(dtype_str)),
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, s, d), jnp.dtype(dtype_str)),
+            jax.ShapeDtypeStruct((h, s), jnp.float32),
+        ),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -101,6 +126,143 @@ def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
         interpret=interpret,
     )
     return jax.jit(call)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2 style): given saved per-row logsumexp
+# L and the precomputed D = rowsum(dO * O), recompute P blockwise and
+# accumulate dQ (sweep over K blocks) and dK/dV (sweep over Q blocks) —
+# O(S·d) memory end to end, no S×S materialization in the backward either.
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
+                   acc_ref, *, scale, causal, bq, bk, k_steps):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)                   # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                   # (bk, d)
+    v = v_ref[0].astype(jnp.float32)                   # (bk, d)
+    do = do_ref[0].astype(jnp.float32)                 # (bq, d)
+    lse = lse_ref[0][:, None]                          # (bq, 1)
+    dd = dd_ref[0][:, None]                            # (bq, 1)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+    p = jnp.exp(s - lse)                               # (bq, bk), exact probs
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)  # (bq, bk)
+    ds = p * (dp - dd) * scale
+    acc_ref[:] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == k_steps - 1)
+    def _flush():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                    dk_ref, dv_ref, acck_ref, accv_ref, *,
+                    scale, causal, bq, bk, q_steps):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        acck_ref[:] = jnp.zeros_like(acck_ref)
+        accv_ref[:] = jnp.zeros_like(accv_ref)
+
+    q = q_ref[0].astype(jnp.float32)                   # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                   # (bk, d)
+    v = v_ref[0].astype(jnp.float32)                   # (bk, d)
+    do = do_ref[0].astype(jnp.float32)                 # (bq, d)
+    lse = lse_ref[0][:, None]                          # (bq, 1)
+    dd = dd_ref[0][:, None]                            # (bq, 1)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+    p = jnp.exp(s - lse)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)             # (bq, bk)
+    # dV += P^T @ dO
+    accv_ref[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dd) * scale                         # (bq, bk)
+    # dK += dS^T @ Q
+    acck_ref[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == q_steps - 1)
+    def _flush():
+        dk_ref[0] = acck_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = accv_ref[:].astype(dv_ref.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
+    if pltpu is None:
+        raise RuntimeError("pallas TPU namespace unavailable")
+    dtype = jnp.dtype(dtype_str)
+    k_steps, q_steps = s // bk, s // bq
+
+    dq_call = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, k_steps=k_steps),
+        grid=(h, q_steps, k_steps),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # dO
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # lse
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # D
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, s, d), dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )
+
+    dkv_call = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, q_steps=q_steps),
+        grid=(h, k_steps, q_steps),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda hh, ki, qi: (hh, qi, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda hh, ki, qi: (hh, qi, 0)),  # dO
+            pl.BlockSpec((1, bq), lambda hh, ki, qi: (hh, qi)),        # lse
+            pl.BlockSpec((1, bq), lambda hh, ki, qi: (hh, qi)),        # D
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, s, d), dtype),
+            jax.ShapeDtypeStruct((h, s, d), dtype),
+        ),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )
+    return jax.jit(dq_call), jax.jit(dkv_call)
 
 
 def _dense_attention_shd(q, k, v, causal: bool, scale: float):
@@ -122,24 +284,37 @@ def _dense_attention_shd(q, k, v, causal: bool, scale: float):
 def _flash_core(q, k, v, causal, scale, bq, bk, interpret):
     S, H, D = q.shape
     qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
-    out = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
-                 interpret)(qh, kh, vh)
+    out, _ = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
+                    interpret)(qh, kh, vh)
     return jnp.transpose(out, (1, 0, 2))
 
 
 def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
-    return _flash_core(q, k, v, causal, scale, bq, bk, interpret), (q, k, v)
+    S, H, D = q.shape
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    out, lse = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
+                      interpret)(qh, kh, vh)
+    o = jnp.transpose(out, (1, 0, 2))
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
-    # backward differentiates the mathematically-identical dense form:
-    # exact gradients, O(S^2) memory in the backward only (the forward
-    # stays O(S·d)).  A Pallas backward kernel can replace this without
-    # touching callers.
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _dense_attention_shd(
-        q_, k_, v_, causal, scale), q, k, v)
-    return vjp(g)
+    # FlashAttention-2-style backward: recompute P blockwise from the saved
+    # per-row logsumexp, sweep K blocks for dQ and Q blocks for dK/dV —
+    # O(S·d) memory, no S×S materialization
+    q, k, v, o, lse = res
+    S, H, D = q.shape
+    qh, kh, vh, doh = (jnp.transpose(x, (1, 0, 2)).astype(q.dtype)
+                       for x in (q, k, v, g))
+    # D_i = rowsum(dO ∘ O), per (head, row)
+    dd = jnp.einsum("shd,shd->hs", g.astype(jnp.float32),
+                    o.astype(jnp.float32))
+    dq_call, dkv_call = _build_bwd(H, S, D, bq, bk, str(q.dtype), scale,
+                                   causal, interpret)
+    dq = dq_call(qh, kh, vh, doh, lse, dd)
+    dk, dv = dkv_call(qh, kh, vh, doh, lse, dd)
+    back = lambda t: jnp.transpose(t, (1, 0, 2)).astype(q.dtype)
+    return back(dq), back(dk), back(dv)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)

@@ -31,7 +31,7 @@ def test_flash_custom_vjp_exact(rng):
     gf = jax.grad(via_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(via_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
-        assert float(jnp.abs(a - b).max()) < 1e-4
+        assert float(jnp.abs(a - b).max()) < 1e-5
 
 
 @pytest.fixture(scope="module")
