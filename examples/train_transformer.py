@@ -1,0 +1,39 @@
+"""Train the flagship transformer (flash-attention kernels, Megatron tp
+layout, dp batch) on a toy counting language, then greedy-decode.
+
+Note: on the CPU fallback the Pallas kernels run in interpret mode, so this
+example takes a couple of minutes; on a real TPU it is seconds.  The toy
+model will not decode perfectly — the point is the machinery."""
+
+import _setup  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from distributedarrays_tpu.models import transformer as T
+from distributedarrays_tpu.models.mlp import make_mesh
+
+cfg = T.Config(vocab=32, dim=64, heads=4, layers=2, max_seq=32)
+mesh = make_mesh()
+print("mesh:", dict(mesh.shape))
+
+params = T.shard_params(T.init_params(jax.random.key(0), cfg), mesh)
+
+# task: every sequence counts upward mod vocab
+start = jax.random.randint(jax.random.key(1), (16, 1), 0, cfg.vocab)
+tokens = ((start + jnp.arange(cfg.max_seq)[None]) % cfg.vocab).astype(jnp.int32)
+tokens = jax.device_put(tokens, jax.NamedSharding(mesh, P("dp", None)))
+
+for step in range(60):
+    params, loss = T.train_step(params, tokens, jnp.float32(0.05), cfg)
+    if step % 20 == 0:
+        print(f"step {step:3d}  loss {float(loss):.4f}")
+print(f"final loss {float(loss):.4f}")
+
+# decode from a prompt length the model has seen in training context
+seq = [(7 + i) % cfg.vocab for i in range(16)]
+for _ in range(6):
+    logits = T.forward(params, jnp.asarray([seq], jnp.int32), cfg)
+    seq.append(int(jnp.argmax(logits[0, -1])))
+print("greedy continuation (last 10):", seq[-10:])
