@@ -10,9 +10,10 @@ DArrays, DDatas, jax.Arrays, numpy arrays, and plain Python values.
 DArrays round-trip **with their layout**: dims, chunk grid, cuts and rank
 assignment are restored exactly, and shard placement happens at load time
 through the same sharding machinery as construction (one device_put
-scatter per array).  Storage is a self-contained ``.npz`` + JSON-metadata
-directory — no optional dependencies; swapping the array store for Orbax
-(async, multi-host) only changes `_ARRS` handling, not the layout format.
+scatter per array).  Storage is a JSON-metadata file plus either a
+self-contained ``.npz`` (default) or an Orbax PyTree store
+(``save(..., store="orbax")`` — the chunked, multi-host-capable tier);
+the layout-metadata format is shared, so both stores restore identically.
 """
 
 from __future__ import annotations
@@ -32,6 +33,7 @@ __all__ = ["save", "load"]
 
 _META = "dartpu_meta.json"
 _ARRS = "arrays.npz"
+_ORBAX = "orbax_store"
 
 
 def _encode(tree, arrays: dict):
@@ -65,7 +67,8 @@ def _encode(tree, arrays: dict):
         return entry
     if isinstance(tree, dict):
         if all(isinstance(k, str) for k in tree) and \
-                not any(k == "__dartpu__" for k in tree):
+                not any(k in ("__dartpu__", "__dartpu_store__")
+                        for k in tree):
             return {k: _encode(v, arrays) for k, v in tree.items()}
         # non-string keys round-trip via an item-pair encoding (plain JSON
         # would silently stringify them)
@@ -137,21 +140,52 @@ def _decode(tree, arrays):
     return tree
 
 
-def save(path: str | os.PathLike, tree: Any) -> None:
-    """Checkpoint a pytree (DArrays keep their layout metadata)."""
+def save(path: str | os.PathLike, tree: Any, store: str = "npz") -> None:
+    """Checkpoint a pytree (DArrays keep their layout metadata).
+
+    ``store``: "npz" (default — single self-contained file pair) or
+    "orbax" (Orbax PyTree store: chunked/ocdbt on-disk format, the
+    multi-host-capable tier).  The layout metadata format is identical, so
+    the two stores are feature-equivalent for restores on one host.
+    """
+    if store not in ("npz", "orbax"):
+        # validate before any side effect (no stray directories/encodes)
+        raise ValueError(f"unknown store {store!r} (use 'npz' or 'orbax')")
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     meta = _encode(tree, arrays)
-    np.savez(path / _ARRS, **arrays)
-    (path / _META).write_text(json.dumps(meta))
+    if store == "orbax" and arrays:
+        import orbax.checkpoint as ocp
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save((path / _ORBAX).resolve(), arrays, force=True)
+    elif store == "npz":
+        np.savez(path / _ARRS, **arrays)
+    # (orbax with no array leaves: nothing to store; load mirrors this)
+    meta_doc = {"__dartpu_store__": store, "tree": meta}
+    (path / _META).write_text(json.dumps(meta_doc))
 
 
 def load(path: str | os.PathLike) -> Any:
-    """Restore a checkpoint; DArrays are re-distributed onto their saved
-    chunk grids (rank lists are clipped to the available devices)."""
+    """Restore a checkpoint (either store); DArrays are re-distributed onto
+    their saved chunk grids (default relayout with a warning when fewer
+    devices are available than at save time)."""
     path = Path(path)
-    meta = json.loads((path / _META).read_text())
-    with np.load(path / _ARRS) as z:
-        arrays = {k: z[k] for k in z.files}
+    meta_doc = json.loads((path / _META).read_text())
+    # positive new-format detection: the sentinel key can never be produced
+    # by _encode (user dicts containing it are item-pair encoded)
+    if isinstance(meta_doc, dict) and "__dartpu_store__" in meta_doc:
+        store, meta = meta_doc["__dartpu_store__"], meta_doc["tree"]
+    else:                                  # pre-store-field checkpoints
+        store, meta = "npz", meta_doc
+    if store == "orbax":
+        if (path / _ORBAX).exists():
+            import orbax.checkpoint as ocp
+            with ocp.PyTreeCheckpointer() as ckptr:
+                arrays = ckptr.restore((path / _ORBAX).resolve())
+        else:                              # array-free checkpoint
+            arrays = {}
+    else:
+        with np.load(path / _ARRS) as z:
+            arrays = {k: z[k] for k in z.files}
     return _decode(meta, arrays)

@@ -61,6 +61,20 @@ def test_checkpoint_preserves_keys_and_scalar_types(tmp_path):
     assert back["flag"].dtype == np.bool_
 
 
+def test_checkpoint_orbax_store(tmp_path, rng):
+    A = rng.standard_normal((50, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    state = {"step": 3, "d": d, "w": jnp.arange(6, dtype=jnp.bfloat16)}
+    checkpoint.save(tmp_path / "cob", state, store="orbax")
+    back = checkpoint.load(tmp_path / "cob")
+    assert back["step"] == 3
+    assert back["d"].cuts[0] == [0, 13, 26, 38, 50]
+    assert np.array_equal(np.asarray(back["d"]), A)
+    assert back["w"].dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="store"):
+        checkpoint.save(tmp_path / "cx", {"a": 1}, store="nope")
+
+
 def test_checkpoint_bfloat16_roundtrip(tmp_path):
     # regression: ml_dtypes arrays (bfloat16) don't survive npz natively
     w = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4) / 7
