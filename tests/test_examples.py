@@ -1,0 +1,23 @@
+"""Smoke-run the fast examples as subprocesses — they are the user-facing
+surface and have caught bugs the unit suite missed (see docs/design.md)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
+
+# fast examples only; the training demos are exercised by their own suites
+FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py"]
+
+
+@pytest.mark.parametrize("script", FAST)
+def test_example_runs(script):
+    env = dict(os.environ, EXAMPLES_FORCE_CPU="1")
+    r = subprocess.run([sys.executable, str(EXAMPLES / script)],
+                       capture_output=True, text=True, timeout=420, env=env)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    assert r.stdout.strip(), f"{script} produced no output"
