@@ -1,0 +1,86 @@
+"""Direct tests for public API entry points only exercised indirectly
+elsewhere."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import layout as L
+from distributedarrays_tpu.models import stencil
+from distributedarrays_tpu.ops.broadcast import broadcasted
+from distributedarrays_tpu.ops.mapreduce import dreduce
+from distributedarrays_tpu.ops.pallas_attention import flash_block_size
+from distributedarrays_tpu.parallel import collectives as C
+from distributedarrays_tpu.parallel import spmd_mode as S
+
+
+def test_broadcasted_alias(rng):
+    A = rng.standard_normal((8, 8)).astype(np.float32)
+    r = broadcasted(jnp.add, dat.distribute(A), 1.0)
+    assert np.allclose(np.asarray(r), A + 1, rtol=1e-6)
+
+
+def test_dreduce(rng):
+    A = rng.standard_normal((16, 4)).astype(np.float32)
+    d = dat.distribute(A)
+    assert np.allclose(float(dreduce("sum", d)), A.sum(), rtol=1e-4)
+    r = dreduce("max", d, dims=0)
+    assert np.allclose(np.asarray(r), A.max(axis=0, keepdims=True))
+
+
+def test_current_rank_and_nprocs():
+    assert dat.current_rank() == 0          # controller
+    out = S.spmd(lambda: (S.myid(), S.nprocs()), pids=[2, 5])
+    assert out == [(2, 2), (5, 2)]
+    assert S.nprocs() == 8                  # outside spmd: all ranks
+
+
+def test_localpartindex():
+    d = dat.dzeros((16, 8), procs=range(8), dist=(4, 2))
+    assert d.localpartindex(0) == (0, 0)
+    assert d.localpartindex(5) == (2, 1)
+    assert d.localpartindex(99) is None
+
+
+def test_all_ranks_next_did():
+    assert L.all_ranks() == list(range(8))
+    a, b = dat.next_did(), dat.next_did()
+    assert a[0] == 0 and b[1] == a[1] + 1
+
+
+def test_axis_size(rng):
+    from jax.sharding import PartitionSpec as P
+    mesh = C.spmd_mesh(4)
+    f = C.run_spmd(lambda x: x * C.axis_size("p"), mesh,
+                   in_specs=P("p"), out_specs=P("p"))
+    assert np.allclose(np.asarray(f(np.ones(4, np.float32))), 4.0)
+
+
+def test_single_step_helpers(rng):
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))
+    s1 = np.asarray(stencil.stencil5_step(d))
+    s2 = np.asarray(stencil.stencil5(d, iters=1))
+    assert np.array_equal(s1, s2)
+    b = (rng.random((16, 8)) < 0.5).astype(np.int32)
+    db = dat.distribute(b, procs=range(4), dist=(4, 1))
+    l1 = np.asarray(stencil.life_step(db))
+    l2 = np.asarray(stencil.life(db, iters=1))
+    assert np.array_equal(l1, l2)
+
+
+def test_flash_block_size():
+    assert flash_block_size(256) == 128
+    assert flash_block_size(96) == 32
+    assert flash_block_size(31) == 1
+    assert flash_block_size(64, cap=32) == 32
+
+
+def test_subdarray_materialize(rng):
+    A = rng.standard_normal((12, 12)).astype(np.float32)
+    d = dat.distribute(A)
+    m = d[2:8, 3:9].materialize()
+    assert m.shape == (6, 6)
+    assert np.array_equal(np.asarray(m), A[2:8, 3:9])
