@@ -17,9 +17,17 @@ PKG_ROOT = Path(dat.__file__).resolve().parent
 
 
 def _all_modules():
-    for info in pkgutil.walk_packages([str(PKG_ROOT)],
-                                      prefix="distributedarrays_tpu."):
-        yield info.name
+    errors = []
+    mods = list(pkgutil.walk_packages([str(PKG_ROOT)],
+                                      prefix="distributedarrays_tpu.",
+                                      onerror=errors.append))
+    assert not errors, f"subpackage import failures: {errors}"
+    # sanity floor: every known subpackage must have been walked
+    names = [m.name for m in mods]
+    for sub in ("ops", "parallel", "models", "utils"):
+        assert any(n.startswith(f"distributedarrays_tpu.{sub}.")
+                   for n in names), f"subpackage {sub} not walked"
+    return names
 
 
 def test_every_export_exists():
@@ -62,9 +70,16 @@ def test_import_has_no_backend_side_effect():
     code = (
         "import jax\n"
         "import distributedarrays_tpu\n"
-        "import jax._src.xla_bridge as xb\n"
-        "assert not xb._backends, f'backends initialized: {xb._backends}'\n"
-        "print('clean')\n"
+        "try:\n"
+        "    import jax._src.xla_bridge as xb\n"
+        "    backends = getattr(xb, '_backends', None)\n"
+        "except ImportError:\n"
+        "    backends = None\n"
+        "if backends is None:\n"
+        "    print('clean (probe unavailable on this jax version)')\n"
+        "else:\n"
+        "    assert not backends, f'backends initialized: {backends}'\n"
+        "    print('clean')\n"
     )
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=120,
