@@ -84,6 +84,47 @@ def test_sort_matches_numpy(n, data):
     dat.d_closeall()
 
 
+@settings(max_examples=20, deadline=None)
+@given(data=st.data())
+def test_spmd_random_message_schedules(data):
+    # random point-to-point schedules with tags: every message must arrive
+    # at its addressee with its payload, regardless of send/recv ordering
+    from distributedarrays_tpu.parallel import spmd_mode as S
+    n = data.draw(st.integers(2, 6))
+    n_msgs = data.draw(st.integers(1, 12))
+    msgs = []                      # (src, dst, tag, payload)
+    for i in range(n_msgs):
+        src = data.draw(st.integers(0, n - 1))
+        dst = data.draw(st.integers(0, n - 1))
+        tag = data.draw(st.integers(0, 2))
+        msgs.append((src, dst, tag, f"m{i}"))
+    by_recv = {}
+    for src, dst, tag, pay in msgs:
+        by_recv.setdefault(dst, []).append((src, tag, pay))
+
+    def prog():
+        me = S.myid()
+        # send all my outgoing messages first (async), then receive mine —
+        # matching on (src, tag); duplicates of a (src, tag) pair arrive
+        # in send order
+        for src, dst, tag, pay in msgs:
+            if src == me:
+                S.sendto(dst, pay, tag=tag)
+        got = []
+        for src, tag, _ in by_recv.get(me, []):
+            got.append((src, tag, S.recvfrom(src, tag=tag, timeout=30)))
+        return got
+
+    out = S.spmd(prog, pids=list(range(n)))
+    for rank, got in zip(range(n), out):
+        want = by_recv.get(rank, [])
+        # payload multiset per (src, tag) must match exactly
+        from collections import Counter
+        w = Counter((s, t, p) for s, t, p in want)
+        g = Counter(got)
+        assert g == w, (rank, got, want)
+
+
 @settings(max_examples=25, deadline=None)
 @given(dims=dims_2d, data=st.data())
 def test_view_slices_match_numpy(dims, data):
