@@ -1,0 +1,39 @@
+"""Pipeline and expert parallelism in one sitting: a GPipe microbatch
+pipeline trained a few steps, and a Switch-style MoE layer routing tokens
+across expert ranks via all_to_all."""
+
+import _setup  # noqa: F401
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributedarrays_tpu.models import moe as M
+from distributedarrays_tpu.models import pipeline as PP
+
+# ---- pipeline: 4 stages, 6 microbatches ---------------------------------
+mesh = PP.make_pp_mesh(4)
+params = PP.init_pipeline_params(jax.random.key(0), 4, 32)
+mb = jax.random.normal(jax.random.key(1), (6, 8, 32))
+tgt = jnp.zeros((6, 8, 32))
+
+out = PP.pipeline_forward(params, mb, mesh)
+err = float(jnp.abs(out - PP.reference_forward(params, mb)).max())
+print(f"pipeline forward exact vs sequential: max err {err:.2e}")
+
+for i in range(10):
+    params, loss = PP.pipeline_train_step(params, mb, tgt, mesh, lr=0.1)
+print(f"pipeline train loss after 10 steps: {float(loss):.4f}")
+
+# ---- MoE: 4 experts, tokens routed via all_to_all -----------------------
+ep_mesh = M.make_ep_mesh(4)
+mp = M.init_moe_params(jax.random.key(2), 4, 16, 32)
+x = jax.random.normal(jax.random.key(3), (32, 16))
+y = M.moe_forward(mp, x, ep_mesh, capacity=8)
+ref = M.reference_moe(mp, x, 8, 4)
+print(f"moe routed output exact vs dense oracle: "
+      f"max err {np.abs(np.asarray(y) - ref).max():.2e}")
+
+tight = M.moe_forward(mp, x, ep_mesh, capacity=1)
+passthrough = int(np.sum(np.all(np.asarray(tight) == np.asarray(x), axis=1)))
+print(f"with capacity=1, {passthrough} overflow tokens took the residual path")
