@@ -124,6 +124,20 @@ def test_mapslices(rng):
     assert np.allclose(np.asarray(r), want, rtol=1e-5)
 
 
+def test_mapslices_untraceable_host_fallback(rng):
+    # f using concrete numpy cannot trace; the host path must cover it
+    A = rng.standard_normal((24, 16)).astype(np.float32)
+    d = dat.distribute(A)
+
+    def untraceable(col):
+        c = np.asarray(col)
+        return c / np.linalg.norm(c)
+
+    r = dat.mapslices(untraceable, d, dims=0)
+    want = A / np.linalg.norm(A, axis=0, keepdims=True)
+    assert np.allclose(np.asarray(r), want, rtol=1e-5)
+
+
 def test_mapslices_shape_change(rng):
     A = rng.standard_normal((24, 16)).astype(np.float32)
     d = dat.distribute(A)
