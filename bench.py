@@ -224,6 +224,47 @@ def main():
     details["stencil_8192_gcells_per_s"] = rows * M / t_st / 1e9
     _save(details)
 
+    # free the bandwidth-config buffers before the 16k arrays go up
+    for arr in (X, Y, Z, V, S):
+        arr.close()
+
+    # ---- config 3: 16384^2 GEMM on an explicit block layout --------------
+    # BASELINE.json configs[3]; reference semantics = the tile-grid
+    # _matmatmul! (/root/reference/src/linalg.jl:189-311), here one jitted
+    # matmul over block-sharded operands (XLA SUMMA over ICI).  A true 2x2
+    # grid needs >=4 devices; on fewer the grid degrades and the key label
+    # says which grid actually ran.  bf16-pass first (banked); the riskier
+    # f32-HIGHEST pass runs in the guarded tail below.
+    K16 = 16384
+    g3 = (2, 2) if ndev >= 4 else (1, 1)
+    tag = f"gemm_16k_{g3[0]}x{g3[1]}"
+    A3 = dat.drand((K16, K16), dtype=jnp.float32,
+                   procs=range(g3[0] * g3[1]), dist=g3)
+    B3 = dat.drand((K16, K16), dtype=jnp.float32,
+                   procs=range(g3[0] * g3[1]), dist=g3)
+    s16 = jnp.float32(1.0 / K16)
+
+    def gemm16_chain_at(precision):
+        def gemm16_chain(L):
+            @dat.djit
+            def f(a, b):
+                def body(c, _):
+                    return jnp.matmul(c, b, precision=precision) * s16, None
+                c, _ = lax.scan(body, a, None, length=L)
+                return jnp.sum(c)
+            float(f(A3, B3))
+            return min(_t(lambda: float(f(A3, B3))) for _ in range(2))
+        return gemm16_chain
+
+    try:
+        t16 = _marginal(gemm16_chain_at(jax.lax.Precision.DEFAULT),
+                        L0=5, min_delta=0.1)
+        details[f"{tag}_bf16pass_marginal_s"] = t16
+        details[f"{tag}_bf16pass_gflops"] = 2 * K16**3 / t16 / 1e9
+    except Exception as e:  # pragma: no cover
+        details[f"{tag}_error"] = f"{type(e).__name__}: {e}"
+    _save(details)
+
     # ---- extra: Pallas flash attention at long context -------------------
     try:
         from distributedarrays_tpu.ops.pallas_attention import flash_attention
@@ -287,6 +328,24 @@ def main():
         details["gemm_f32_highest_error"] = "timed out (remote compile hang)"
     elif isinstance(res, Exception):
         details["gemm_f32_highest_error"] = f"{type(res).__name__}: {res}"
+    else:
+        details.update(res)
+    _save(dict(details))
+
+    # the 16k f32-HIGHEST pass (the BASELINE config-3 metric), same guard
+    def highest16():
+        out = {}
+        t = _marginal(gemm16_chain_at(jax.lax.Precision.HIGHEST),
+                      L0=3, min_delta=0.2)
+        out[f"{tag}_f32_highest_marginal_s"] = t
+        out[f"{tag}_f32_highest_gflops"] = 2 * K16**3 / t / 1e9
+        return out
+
+    finished, res = _run_with_timeout(highest16, 600)
+    if not finished:
+        details[f"{tag}_f32_highest_error"] = "timed out (remote compile hang)"
+    elif isinstance(res, Exception):
+        details[f"{tag}_f32_highest_error"] = f"{type(res).__name__}: {res}"
     else:
         details.update(res)
 

@@ -18,7 +18,7 @@ from .darray import (DArray, SubDArray, SubOrDArray, DData, darray,
                      darray_like, from_chunks, dzeros, dones, dfill, drand,
                      drandint, dsample, drandn, distribute, ddata, gather, localpart,
                      localindices, locate, makelocal, seed, copyto_, dcat,
-                     dfetch)
+                     dfetch, isassigned)
 from .layout import (defaultdist, defaultdist_1d, chunk_idxs, mesh_for,
                      sharding_for, nranks, all_ranks)
 from .ops.broadcast import dmap, dmap_into, djit, broadcasted

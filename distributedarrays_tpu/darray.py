@@ -63,6 +63,7 @@ __all__ = [
     "copyto_",
     "dcat",
     "dfetch",
+    "isassigned",
 ]
 
 
@@ -122,6 +123,78 @@ def _resharder(sharding):
 
 
 # ---------------------------------------------------------------------------
+# Blocked padding (uneven layouts): physical storage is the logical chunk
+# grid with every chunk padded to the per-dim max extent, sharded one block
+# per device — so an uneven DArray stores ~1/grid per device instead of a
+# full replica along the ragged axis (reference stores uneven chunks
+# distributed, darray.jl:279-296).  The pad region always holds zeros.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _blocked_pad_jit(cuts_key, psharding):
+    """logical (dims) -> blocked-padded (pdims) buffer, zero pad."""
+    cuts = [list(c) for c in cuts_key]
+    bs = L.block_sizes(cuts)
+
+    def fn(x):
+        for d, c in enumerate(cuts):
+            nc, b = len(c) - 1, bs[d]
+            if nc == 0 or b * nc == c[-1]:
+                continue
+            pieces = []
+            for k in range(nc):
+                piece = jax.lax.slice_in_dim(x, c[k], c[k + 1], axis=d)
+                if c[k + 1] - c[k] < b:
+                    pw = [(0, 0)] * x.ndim
+                    pw[d] = (0, b - (c[k + 1] - c[k]))
+                    piece = jnp.pad(piece, pw)
+                pieces.append(piece)
+            x = jnp.concatenate(pieces, axis=d)
+        return x
+
+    return jax.jit(fn, out_shardings=psharding)
+
+
+@functools.lru_cache(maxsize=None)
+def _blocked_unpad_jit(cuts_key, lsharding):
+    """blocked-padded (pdims) -> logical (dims) global array."""
+    cuts = [list(c) for c in cuts_key]
+    bs = L.block_sizes(cuts)
+
+    def fn(x):
+        for d, c in enumerate(cuts):
+            nc, b = len(c) - 1, bs[d]
+            if nc == 0 or b * nc == c[-1]:
+                continue
+            pieces = [jax.lax.slice_in_dim(x, k * b, k * b + (c[k + 1] - c[k]),
+                                           axis=d)
+                      for k in range(nc) if c[k + 1] > c[k]]
+            x = jnp.concatenate(pieces, axis=d) if pieces else \
+                jax.lax.slice_in_dim(x, 0, 0, axis=d)
+        return x
+
+    return jax.jit(fn, out_shardings=lsharding)
+
+
+def _host_blocked_pad(arr: np.ndarray, cuts, bs, pdims) -> np.ndarray:
+    """numpy blocked pad — used at construction so each device receives only
+    its block (never a full logical replica)."""
+    out = np.zeros(pdims, dtype=arr.dtype)
+    grid = tuple(len(c) - 1 for c in cuts)
+    for ci in np.ndindex(*grid):
+        src = tuple(slice(c[k], c[k + 1]) for c, k in zip(cuts, ci))
+        dst = tuple(slice(k * b, k * b + (c[k + 1] - c[k]))
+                    for c, b, k in zip(cuts, bs, ci))
+        out[dst] = arr[src]
+    return out
+
+
+def _cuts_key(cuts) -> tuple:
+    return tuple(tuple(int(x) for x in c) for c in cuts)
+
+
+# ---------------------------------------------------------------------------
 # DArray
 # ---------------------------------------------------------------------------
 
@@ -145,6 +218,9 @@ class DArray:
         "cuts",
         "_data",
         "_sharding",
+        "_padded",
+        "_bs",
+        "_psharding",
         "_closed",
         "_mutlock",
         "__weakref__",
@@ -153,12 +229,40 @@ class DArray:
     def __init__(self, data: jax.Array, pids: np.ndarray, indices: np.ndarray,
                  cuts: list, did=None):
         self.id = did if did is not None else core.next_did()
-        self.dims = tuple(int(s) for s in data.shape)
+        if len(cuts) != getattr(data, "ndim", len(np.shape(data))):
+            raise ValueError(
+                f"cuts rank {len(cuts)} != data rank {np.ndim(data)}")
+        dims = tuple(int(c[-1]) for c in cuts)
+        self.dims = dims
         self.pids = pids
         self.indices = indices
         self.cuts = cuts
+        self._bs = L.block_sizes(cuts)
+        pdims = L.padded_dims(cuts)
+        self._padded = pdims != dims
+        if self._padded:
+            grid = tuple(len(c) - 1 for c in cuts)
+            flat_pids = [int(p) for p in pids.flat]
+            psh = L.padded_sharding_for(flat_pids, grid, pdims)
+            if tuple(data.shape) == pdims:
+                if getattr(data, "sharding", psh) != psh:
+                    data = jax.device_put(data, psh)
+            elif tuple(data.shape) == dims:
+                data = _blocked_pad_jit(_cuts_key(cuts), psh)(data)
+            else:
+                raise ValueError(f"data shape {tuple(data.shape)} matches "
+                                 f"neither dims {dims} nor padded {pdims}")
+            self._psharding = psh
+            # ops-facing sharding of the *logical* view (uneven axes
+            # replicated — the pre-padding physical layout, now transient)
+            self._sharding = L.sharding_for(flat_pids, grid, dims)
+        else:
+            if tuple(data.shape) != dims:
+                raise ValueError(
+                    f"data shape {tuple(data.shape)} != cuts dims {dims}")
+            self._psharding = None
+            self._sharding = data.sharding
         self._data = data
-        self._sharding = data.sharding
         self._closed = False
         # serializes read-modify-write mutations (set_localpart/setitem)
         # from concurrent SPMD rank tasks: the reference's workers own
@@ -194,7 +298,24 @@ class DArray:
 
     @property
     def garray(self) -> jax.Array:
-        """The underlying global sharded jax.Array (TPU-native escape hatch)."""
+        """The logical global jax.Array (TPU-native escape hatch).
+
+        Even layouts: the stored sharded buffer, as-is (the performance
+        path).  Uneven layouts: reassembled on the fly from the
+        blocked-padded buffer — one compiled slice+concat program whose
+        result replicates the ragged axes (transient; the at-rest storage
+        stays one block per device)."""
+        self._check_open()
+        if not self._padded:
+            return self._data
+        return _blocked_unpad_jit(_cuts_key(self.cuts), self._sharding)(
+            self._data)
+
+    @property
+    def garray_padded(self) -> jax.Array:
+        """The at-rest physical buffer: the blocked-padded sharded array for
+        uneven layouts (one max-chunk-sized block per device, zero pad), or
+        exactly ``garray`` for even ones."""
         self._check_open()
         return self._data
 
@@ -299,6 +420,11 @@ class DArray:
         if ci is None:
             return jnp.empty((0,) * max(self.ndim, 1), dtype=self.dtype)
         idx = self.indices[ci]
+        if self._padded:
+            shard = self._padded_shard(ci, idx)
+            if shard is not None:
+                return shard
+            return self.garray[tuple(slice(r.start, r.stop) for r in idx)]
         shard = self._physical_shard_matching(idx)
         if shard is not None:
             return shard
@@ -313,6 +439,21 @@ class DArray:
                     for d, (x, r) in enumerate(zip(sl, idx))
                 ):
                     return s.data
+        except Exception:
+            pass
+        return None
+
+    def _padded_shard(self, ci, idx):
+        """Addressable-shard fast path for uneven layouts: grid cell ``ci``'s
+        chunk lives in the physical block starting at ``ci*block_size``; its
+        valid region is a device-local slice — no cross-device traffic."""
+        starts = tuple(int(c) * b for c, b in zip(ci, self._bs))
+        try:
+            for s in self._data.addressable_shards:
+                if len(s.index) == len(starts) and all(
+                    (x.start or 0) == st for x, st in zip(s.index, starts)
+                ):
+                    return s.data[tuple(slice(0, len(r)) for r in idx)]
         except Exception:
             pass
         return None
@@ -338,6 +479,17 @@ class DArray:
         want = tuple(len(r) for r in idx)
         if value.shape != want:
             raise ValueError(f"localpart shape {value.shape} != chunk shape {want}")
+        if self._padded:
+            # write straight into the owner's physical block (pad stays 0)
+            psl = tuple(slice(b * c, b * c + len(r))
+                        for b, c, r in zip(self._bs, ci, idx))
+            with self._mutlock:
+                self._check_open()
+                g2 = self._data.at[psl].set(value)
+                if g2.sharding != self._psharding:
+                    g2 = jax.device_put(g2, self._psharding)
+                self._data = g2
+            return
         sl = tuple(slice(r.start, r.stop) for r in idx)
         self._mutate(lambda g: g.at[sl].set(value))
 
@@ -356,7 +508,7 @@ class DArray:
 
     def _gather_host(self):
         self._check_open()
-        return jax.device_get(self._data)
+        return jax.device_get(self.garray)
 
     def _mutate(self, updater):
         """Atomic read-modify-write of the backing buffer: every partial
@@ -366,10 +518,16 @@ class DArray:
             self._rebind(updater(self.garray))
 
     def _rebind(self, new_data: jax.Array):
-        """Swap the backing buffer in place (mutation-API support)."""
+        """Swap the backing buffer in place (mutation-API support).
+        ``new_data`` is always the *logical* global array; uneven layouts
+        re-pad it into blocked physical form."""
         self._check_open()
         if new_data.shape != tuple(self.dims):
             raise ValueError("rebind shape mismatch")
+        if self._padded:
+            self._data = _blocked_pad_jit(_cuts_key(self.cuts),
+                                          self._psharding)(new_data)
+            return
         if new_data.sharding != self._sharding:
             if new_data.size == 0:
                 # XLA rejects out_shardings on zero-element results;
@@ -381,7 +539,10 @@ class DArray:
 
     def with_data(self, new_data: jax.Array, did=None) -> "DArray":
         """New DArray with this layout and ``new_data`` (same global shape)."""
-        return DArray(_to_sharding(new_data, self._sharding), self.pids.copy(),
+        if not self._padded:
+            new_data = _to_sharding(new_data, self._sharding)
+        # padded: the ctor's blocked-pad jit places it, whatever its sharding
+        return DArray(new_data, self.pids.copy(),
                       self.indices, self.cuts, did=did)
 
     # -- indexing ----------------------------------------------------------
@@ -392,6 +553,12 @@ class DArray:
         if all(isinstance(k, int) for k in key):
             # scalar read: guarded remote fetch (darray.jl:649-659)
             _scalar_indexing_allowed()
+            if self._padded:
+                # fetch from the owning block directly (no reassembly)
+                ci = self.locate(*key)
+                local = tuple(b * c + (k - r.start) for b, c, k, r in zip(
+                    self._bs, ci, key, self.indices[ci]))
+                return self._data[local]
             return self._data[tuple(key)]
         # range indexing returns a lazy view (darray.jl:661)
         return SubDArray(self, key)
@@ -414,10 +581,10 @@ class DArray:
         single-controller JAX both are an XLA slice)."""
         self._check_open()
         if not I:
-            return self._data
+            return self.garray
         key = _normalize_key(tuple(I) if len(I) > 1 else I[0], self.dims)
         key = tuple(slice(k, k + 1) if isinstance(k, int) else k for k in key)
-        return self._data[key]
+        return self.garray[key]
 
     # -- conveniences ------------------------------------------------------
 
@@ -468,7 +635,8 @@ class DArray:
         return _wrap_global(jnp.reshape(self.garray, dims), procs=pids)
 
     def astype(self, dtype) -> "DArray":
-        return self.with_data(self.garray.astype(dtype))
+        g = self.garray
+        return self.with_data(_fresh(g.astype(dtype), g))
 
     def fill_(self, x) -> "DArray":
         """In-place fill (reference ``fill!``, darray.jl:822-827)."""
@@ -525,6 +693,11 @@ class SubDArray:
         """Dense jax.Array of the viewed region (reference Array(::SubDArray),
         darray.jl:584-596, incl. the whole-chunk fast path via locate)."""
         self.parent._check_open()
+        if any(not isinstance(k, (int, slice)) for k in self.key):
+            # advanced indexing: apply the raw key so jnp uses numpy's
+            # broadcast-and-place rules — keeps the data consistent with
+            # what _result_shape promised for self.shape
+            return self.parent.garray[self.key]
         key = tuple(slice(k, k + 1) if isinstance(k, int) else k for k in self.key)
         out = self.parent.garray[key]
         # squeeze integer-indexed dims like numpy basic indexing
@@ -639,14 +812,28 @@ def _normalize_key(key, dims):
 
 
 def _result_shape(key, dims):
+    """Shape of ``d[key]`` under numpy/jax advanced-indexing rules: all
+    advanced indices (arrays; ints join as 0-d) broadcast together into ONE
+    dim block, placed at the first advanced position when they are
+    consecutive, else moved to the front."""
+    adv = [(i, np.shape(k)) for i, k in enumerate(key)
+           if not isinstance(k, slice)]
+    has_arrays = any(s != () for _, s in adv)
+    bshape = np.broadcast_shapes(*[s for _, s in adv]) if has_arrays else ()
+    positions = [i for i, _ in adv]
+    consecutive = positions == list(range(positions[0],
+                                          positions[0] + len(positions))) \
+        if positions else True
     shape = []
+    if bshape and not consecutive:
+        shape.extend(bshape)
+    emitted = not bshape or not consecutive
     for d, k in enumerate(key):
-        if isinstance(k, int):
-            continue
         if isinstance(k, slice):
             shape.append(len(range(*k.indices(dims[d]))))
-        else:
-            shape.append(int(np.shape(k)[0]))
+        elif not emitted:
+            shape.extend(bshape)
+            emitted = True
     return tuple(shape)
 
 
@@ -694,7 +881,47 @@ def _wrap_global(data: jax.Array, procs=None, dist=None) -> DArray:
 def _to_sharding(data: jax.Array, sharding) -> jax.Array:
     if getattr(data, "sharding", None) == sharding:
         return data
-    return jax.device_put(data, sharding)
+    return _put_global(data, sharding)
+
+
+def _put_global(host, sharding) -> jax.Array:
+    """Place host/device data under ``sharding``.
+
+    Single-controller: one ``device_put`` (the DestinationSerializer scatter,
+    serialize.jl:45-87).  Multi-controller (a mesh spanning hosts, where some
+    devices are non-addressable): every process calls this with the same
+    global data and contributes only its addressable shards — the JAX analog
+    of each worker receiving only its own chunk."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(host, sharding)
+    arr = np.asarray(host)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def _place_chunked(host, pids: np.ndarray, cuts, sharding) -> jax.Array:
+    """Place host data for a DArray ctor: even layouts go straight to their
+    distributed sharding; uneven layouts are blocked-padded ON HOST first so
+    each device receives only its own block (never a logical replica)."""
+    bs = L.block_sizes(cuts)
+    pdims = L.padded_dims(cuts)
+    dims = tuple(int(c[-1]) for c in cuts)
+    if pdims == dims:
+        return _put_global(host, sharding)
+    grid = tuple(len(c) - 1 for c in cuts)
+    psh = L.padded_sharding_for([int(p) for p in pids.flat], grid, pdims)
+    return _put_global(_host_blocked_pad(np.asarray(host), cuts, bs, pdims),
+                       psh)
+
+
+def _fresh(val: jax.Array, *sources) -> jax.Array:
+    """Guarantee ``val`` owns its buffers: no-op conversions (``device_put``
+    with the current sharding, ``astype`` with the current dtype,
+    ``jnp.asarray`` of a jax.Array) return their *input object*, and two
+    DArrays must never share one buffer — ``close()`` on either would
+    delete the other's data.  The reference always copies here
+    (copyto!/distribute/deepcopy)."""
+    return jnp.copy(val) if any(val is s for s in sources) else val
 
 
 def _assemble_host(dims, dtype, parts, idxs_list) -> np.ndarray:
@@ -745,7 +972,7 @@ def darray(init: Callable, dims, procs=None, dist=None) -> DArray:
     order = list(parts.keys())
     host = _assemble_host(dims, dtype, [parts[ci] for ci in order],
                           [idxs[ci] for ci in order])
-    return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
+    return DArray(_place_chunked(host, pids, cuts, sharding), pids, idxs, cuts)
 
 
 def darray_like(init: Callable, d: DArray) -> DArray:
@@ -794,7 +1021,7 @@ def from_chunks(chunks: np.ndarray, procs=None) -> DArray:
     idxs_list = [idxs[ci] for ci in np.ndindex(*grid)]
     host = _assemble_host(dims, dtype, parts, idxs_list)
     sharding = L.sharding_for(list(pids.flat), grid, dims)
-    return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
+    return DArray(_place_chunked(host, pids, cuts, sharding), pids, idxs, cuts)
 
 
 def darray_from_cuts(host, procs, cuts) -> DArray:
@@ -818,7 +1045,7 @@ def darray_from_cuts(host, procs, cuts) -> DArray:
     # other constructor (L.sharding_for): logical cuts may be uneven while
     # the physical layout stays sharded wherever XLA allows
     sharding = L.sharding_for(use, grid, dims)
-    return DArray(jax.device_put(host, sharding), pids, idxs, cuts)
+    return DArray(_place_chunked(host, pids, cuts, sharding), pids, idxs, cuts)
 
 
 def dzeros(dims, dtype=jnp.float32, procs=None, dist=None) -> DArray:
@@ -923,7 +1150,7 @@ def distribute(A, procs=None, dist=None, like: DArray | None = None) -> DArray:
             np.shape(A), [int(p) for p in like.pids.flat], list(like.pids.shape))
     else:
         dims, pids, idxs, cuts, sharding = _resolve_layout(np.shape(A), procs, dist)
-    return DArray(jax.device_put(A, sharding), pids, idxs, cuts)
+    return DArray(_fresh(_place_chunked(A, pids, cuts, sharding), A), pids, idxs, cuts)
 
 
 # ---------------------------------------------------------------------------
@@ -1106,12 +1333,12 @@ def copyto_(dest, src) -> "DArray":
         return dest
     if not isinstance(dest, DArray):
         raise TypeError("copyto_ expects a DArray or SubDArray destination")
-    val = src.garray if isinstance(src, DArray) else (
+    raw = src.garray if isinstance(src, DArray) else (
         src.materialize() if isinstance(src, SubDArray) else jnp.asarray(src))
-    if tuple(val.shape) != dest.dims:
-        raise ValueError(f"copyto_: src shape {tuple(val.shape)} != dest "
+    if tuple(raw.shape) != dest.dims:
+        raise ValueError(f"copyto_: src shape {tuple(raw.shape)} != dest "
                          f"dims {dest.dims}")
-    dest._rebind(val.astype(dest.dtype))
+    dest._rebind(_fresh(raw.astype(dest.dtype), raw, src))
     return dest
 
 
@@ -1131,6 +1358,40 @@ def dfetch(d: DArray, *i: int):
     """Fetch one element without the scalar guard (reference Base.fetch(d,i),
     darray.jl:386-391 — an explicit, intentional remote fetch)."""
     return d.garray[tuple(i)]
+
+
+def isassigned(d, *i: int) -> bool:
+    """True iff ``d[i...]`` is in bounds and holds a value (reference
+    Base.isassigned, darray.jl:663-674: attempt the raw fetch, False on
+    BoundsError/UndefRefError, rethrow anything else).
+
+    Dense DArray chunks are always materialized, so this reduces to a
+    bounds check; for ``DData`` it additionally requires the owning rank's
+    part to exist."""
+    if isinstance(d, DData):
+        if len(i) != 1:
+            return False
+        k = int(i[0])
+        return 0 <= k < len(d.pids) and int(d.pids[k]) in d._parts
+    if isinstance(d, SubDArray):
+        if len(i) != len(d.shape):
+            return False
+        try:
+            return all(-n <= int(k) < n for k, n in zip(i, d.shape))
+        except (TypeError, ValueError):
+            return False
+    if not isinstance(d, DArray):
+        raise TypeError(f"isassigned expects a DArray/SubDArray/DData, "
+                        f"got {type(d).__name__}")
+    d._check_open()
+    if len(i) != len(d.dims):
+        return False
+    try:
+        _normalize_key(tuple(int(k) for k in i) if len(i) != 1 else int(i[0]),
+                       d.dims)
+    except IndexError:
+        return False
+    return True
 
 
 def gather(d):

@@ -42,7 +42,9 @@ __all__ = ["dmap", "dmap_into", "djit", "broadcasted"]
 # ---------------------------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=None)
+# bounded: user callables are often fresh lambdas; an unbounded cache would
+# accumulate jit wrappers (and captured closures) forever
+@functools.lru_cache(maxsize=512)
 def _jitted(fn: Callable, out_sharding):
     if out_sharding is None:
         return jax.jit(fn)

@@ -18,6 +18,7 @@ XLA resharding.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Callable
 
 import numpy as np
@@ -104,16 +105,112 @@ def _norm_dims(dims, ndim):
 def dmapreduce(f: Callable, op_name_or_fn, d, dims=None):
     """``mapreduce(f, op, d)`` (reference mapreduce.jl:17-35).
 
-    ``op`` may be a name from {sum, prod, max, min, all, any} or any
-    jnp-style reducing callable taking ``axis``/``keepdims`` kwargs.
+    ``op`` may be a name from {sum, prod, max, min, all, any}, any
+    jnp-style reducing callable taking ``axis``/``keepdims`` kwargs, or —
+    like the reference, which accepts *any* associative binary ``op`` —
+    a plain two-argument callable, reduced by a traced pairwise tree fold
+    (the compiled analog of the reference's two-phase local-then-partials
+    reduce) with a host fold as the untraceable-op fallback.
     """
     reducer = _REDUCERS.get(op_name_or_fn, op_name_or_fn) \
         if isinstance(op_name_or_fn, str) else op_name_or_fn
+    if callable(reducer) and _is_binary_op(reducer):
+        return _binary_reduce(d, f, reducer, dims)
     return _reduce_impl(d, f, reducer, dims=dims)
 
 
 def dreduce(op_name_or_fn, d, dims=None):
     return dmapreduce(None, op_name_or_fn, d, dims=dims)
+
+
+def _is_binary_op(fn) -> bool:
+    """True for a plain binary operator ``op(a, b)`` — as opposed to a
+    jnp-style reducer ``op(a, axis=..., keepdims=...)``."""
+    if fn in _REDUCERS.values():
+        return False
+    if isinstance(fn, np.ufunc):
+        return fn.nin == 2
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):  # builtins without introspectable sigs
+        return False
+    params = list(sig.parameters.values())
+    if any(p.name in ("axis", "dims") for p in params):
+        return False
+    required = [p for p in params
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty]
+    return len(required) == 2
+
+
+@functools.lru_cache(maxsize=512)
+def _binary_fold_jit(mapper, op, axes, ndim):
+    """Jitted pairwise tree fold of ``op`` over the flattened reduce axes.
+
+    The halving loop runs at trace time (static shapes), emitting
+    O(log n) vectorized applications of ``op`` — the compiled counterpart
+    of the reference's local-reduce + partials tree (mapreduce.jl:29-35).
+    ``op`` must be elementwise-vectorizable (true for anything built from
+    jnp ops); scalar-only Python ops take the host fallback path.
+    """
+    def fn(a):
+        m = mapper(a) if mapper is not None else a
+        if axes is None:
+            v = m.reshape(-1)
+        else:
+            keep = tuple(i for i in range(ndim) if i not in axes)
+            v = jnp.transpose(m, axes + keep)
+            v = v.reshape((-1,) + tuple(m.shape[i] for i in keep))
+        while v.shape[0] > 1:
+            k = v.shape[0] // 2
+            # order-preserving pairing (adjacent elements combine) so
+            # associative-but-non-commutative ops match a left fold
+            head = op(v[0:2 * k:2], v[1:2 * k:2])
+            v = head if v.shape[0] % 2 == 0 else \
+                jnp.concatenate([head, v[2 * k:]], axis=0)
+        return v[0]
+    return jax.jit(fn)
+
+
+def _binary_reduce(d, mapper, op, dims):
+    x = _unwrap(d)
+    ndim = np.ndim(x)
+    axes = _norm_dims(dims, ndim)
+    n = int(np.prod([np.shape(x)[i] for i in axes])) if axes is not None \
+        else int(np.prod(np.shape(x)))
+    if n == 0:
+        raise ValueError("reduce of empty DArray with no init value")
+    try:
+        res = _binary_fold_jit(mapper, op, axes, ndim)(x)
+    except (jax.errors.JAXTypeError, TypeError):
+        # op cannot trace (concretizes/branches on values): host fold.
+        # Device-side failures (OOM, bad shapes) surface unmasked.
+        res = _binary_reduce_host(np.asarray(x), mapper, op, axes, ndim)
+    if axes is None:
+        return res
+    res = jnp.expand_dims(jnp.asarray(res), axes)  # keepdims, like _reduce_impl
+    if isinstance(d, DArray):
+        dist = [1 if i in axes else c for i, c in enumerate(d.pids.shape)]
+        pids = [int(p) for p in d.pids.flat]
+        return _wrap_global(res, procs=pids, dist=_fit_dist(res.shape, dist))
+    return _wrap_global(res)
+
+
+def _binary_reduce_host(x, mapper, op, axes, ndim):
+    """Linear (left-fold) host reduction for ops that cannot trace.  Such
+    ops are scalar Python functions, so the fold is applied per kept-axis
+    position, scalar by scalar."""
+    if mapper is not None:
+        x = np.asarray(mapper(x))
+    if axes is None:
+        return functools.reduce(op, x.reshape(-1).tolist())
+    keep = tuple(i for i in range(ndim) if i not in axes)
+    v = np.transpose(x, axes + keep).reshape(
+        (-1,) + tuple(x.shape[i] for i in keep))
+    flat = v.reshape(v.shape[0], -1)
+    cols = [functools.reduce(op, flat[:, j].tolist())
+            for j in range(flat.shape[1])]
+    return np.asarray(cols).reshape(v.shape[1:])
 
 
 def _named(name):
@@ -246,7 +343,9 @@ def samedist(d: DArray, like: DArray) -> DArray:
     instead of gather/re-scatter through the controller."""
     if d.dims != like.dims:
         raise ValueError(f"dims mismatch: {d.dims} vs {like.dims}")
-    return like.with_data(jax.device_put(d.garray, like.sharding))
+    from ..darray import _fresh
+    g = d.garray
+    return like.with_data(_fresh(jax.device_put(g, like.sharding), g))
 
 
 # ---------------------------------------------------------------------------

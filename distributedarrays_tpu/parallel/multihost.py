@@ -47,9 +47,17 @@ def initialize(coordinator_address: str | None = None,
         return
     try:
         jax.distributed.initialize()
-    except Exception:
-        # no cluster environment detected: single-process mode
-        pass
+    except ValueError as e:
+        # Degrade to single-process mode ONLY for the "nothing configured"
+        # signature: auto-detection found no cluster, so initialize() had
+        # no coordinator_address to use (a ValueError raised before any
+        # connection attempt).  Real join failures (unreachable
+        # coordinator, timeout, double init) are RuntimeErrors and must
+        # surface — a pod job silently running single-process is the worst
+        # failure mode.
+        if "coordinator_address" in str(e):
+            return
+        raise
 
 
 def process_info() -> dict:

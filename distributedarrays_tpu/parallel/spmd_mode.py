@@ -322,9 +322,12 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
                                 daemon=True) for p in ctx.pids]
     for t in threads:
         t.start()
+    # one shared deadline: the documented timeout bounds the whole run, not
+    # each join (nranks sequential joins would multiply the worst case)
+    deadline = time.monotonic() + timeout
     try:
         for t in threads:
-            t.join(timeout)
+            t.join(max(0.0, deadline - time.monotonic()))
             if t.is_alive():
                 ctx._failed.set()      # wake blocked receivers
                 for t2 in threads:

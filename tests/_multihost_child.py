@@ -1,0 +1,65 @@
+"""Child program for the 2-process jax.distributed smoke test.
+
+Run as: python tests/_multihost_child.py <coordinator_port> <process_id>
+
+Each process owns 4 virtual CPU devices; together they form one 8-device
+global mesh — the moral equivalent of the reference's multi-process
+addprocs harness (/root/reference/test/runtests.jl:10-13), but with two
+real OS processes joined through ``jax.distributed`` (the DCN path).
+"""
+
+import os
+import sys
+
+port, proc_id = sys.argv[1], int(sys.argv[2])
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from distributedarrays_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=proc_id)
+
+info = multihost.process_info()
+assert info["process_count"] == 2, info
+assert info["local_devices"] == 4, info
+assert info["global_devices"] == 8, info
+
+mesh = multihost.global_mesh((8,), ("x",))
+
+# --- one psum across both processes (compiled collective over "DCN") ------
+sh = NamedSharding(mesh, P("x"))
+host = np.arange(8.0, dtype=np.float32)
+garr = jax.make_array_from_callback((8,), sh, lambda idx: host[idx])
+total = jax.jit(jax.shard_map(lambda x: jax.lax.psum(jnp.sum(x), "x"),
+                              mesh=mesh, in_specs=P("x"), out_specs=P()))(garr)
+assert float(total.addressable_data(0)) == 28.0, total
+
+# --- one DArray constructed across processes ------------------------------
+import distributedarrays_tpu as dat  # noqa: E402
+
+A = np.arange(16.0, dtype=np.float32)
+d = dat.distribute(A)  # default layout spans all 8 global devices
+assert not d.garray.is_fully_addressable, "DArray should span both processes"
+assert len(d.garray.addressable_shards) == 4  # this process's local shards
+s = dat.dsum(d)
+assert float(s.addressable_data(0)) == 120.0, s
+
+# localpart of a rank owned by this process comes off a local shard
+local_pids = [pid for pid, _ in multihost.host_local_slice(d)]
+assert len(local_pids) == 4, local_pids
+lp = d.localpart(local_pids[0])
+assert int(np.asarray(lp).size) == 2
+
+d.close()
+multihost.sync_hosts("done")
+print(f"MULTIHOST_OK proc={proc_id}")

@@ -1,0 +1,76 @@
+"""Buffer-ownership regression tests: two DArrays must never share one
+jax buffer, because ``close()`` calls ``jax.Array.delete()`` which would
+invalidate the other handle.  The reference always copies on these paths
+(copyto! darray.jl:679-687, distribute darray.jl:544-555, deepcopy
+darray.jl:689-697); JAX's no-op conversions (``device_put`` with the
+current sharding, ``astype`` with the current dtype) return the *same
+object*, so every construction path must force a fresh buffer when the
+source is still owned by someone else.
+"""
+
+import numpy as np
+import pytest
+
+import distributedarrays_tpu as dat
+
+
+def _usable(d):
+    """The array's buffers are alive and readable."""
+    return (not d.garray.is_deleted()) and np.isfinite(np.asarray(d)).all()
+
+
+def test_copyto_same_dtype_does_not_alias(rng):
+    src = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    dest = dat.dzeros((16, 8), dtype=np.float32)
+    dat.copyto_(dest, src)
+    ref = np.asarray(src).copy()
+    dest.close()
+    # src must survive dest's close
+    np.testing.assert_array_equal(np.asarray(src), ref)
+    src.close()
+
+
+def test_copyto_then_close_src(rng):
+    src = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    dest = dat.dzeros((16, 8), dtype=np.float32)
+    dat.copyto_(dest, src)
+    ref = np.asarray(src).copy()
+    src.close()
+    np.testing.assert_array_equal(np.asarray(dest), ref)
+    dest.close()
+
+
+def test_distribute_of_darray_does_not_alias(rng):
+    d = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    d2 = dat.distribute(d)  # same default layout -> device_put would no-op
+    ref = np.asarray(d).copy()
+    d2.close()
+    np.testing.assert_array_equal(np.asarray(d), ref)
+    d.close()
+
+
+def test_distribute_of_jax_array_does_not_alias(rng):
+    d = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    g = d.garray
+    d2 = dat.distribute(g)
+    d2.close()
+    # the raw jax.Array the user passed must stay alive
+    assert not g.is_deleted()
+    d.close()
+
+
+def test_astype_same_dtype_does_not_alias(rng):
+    d = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    d2 = d.astype(np.float32)
+    d2.close()
+    assert _usable(d)
+    d.close()
+
+
+def test_samedist_already_matching_does_not_alias(rng):
+    a = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    b = dat.dzeros((16, 8), dtype=np.float32)
+    c = dat.samedist(a, b)  # a already has b's layout
+    c.close()
+    assert _usable(a)
+    dat.d_closeall()

@@ -84,3 +84,96 @@ def test_subdarray_materialize(rng):
     m = d[2:8, 3:9].materialize()
     assert m.shape == (6, 6)
     assert np.array_equal(np.asarray(m), A[2:8, 3:9])
+
+
+# ---------------------------------------------------------------------------
+# isassigned (reference Base.isassigned, darray.jl:663-674)
+# ---------------------------------------------------------------------------
+
+
+def test_isassigned_darray(rng):
+    d = dat.distribute(rng.standard_normal((8, 6)).astype(np.float32))
+    assert dat.isassigned(d, 0, 0)
+    assert dat.isassigned(d, 7, 5)
+    assert dat.isassigned(d, -1, -1)      # numpy-style wrap is in bounds
+    assert not dat.isassigned(d, 8, 0)    # out of bounds
+    assert not dat.isassigned(d, 0, 6)
+    assert not dat.isassigned(d, 0)       # wrong arity
+    assert not dat.isassigned(d, 0, 0, 0)
+
+
+def test_isassigned_subdarray(rng):
+    d = dat.distribute(rng.standard_normal((8, 6)).astype(np.float32))
+    v = d[2:6, 1:4]
+    assert dat.isassigned(v, 0, 0)
+    assert dat.isassigned(v, 3, 2)
+    assert not dat.isassigned(v, 4, 0)
+    assert not dat.isassigned(v, 0, 3)
+
+
+def test_isassigned_ddata():
+    dd = dat.ddata(init=lambda i: f"part{i}")
+    assert dat.isassigned(dd, 0)
+    assert dat.isassigned(dd, len(dd) - 1)
+    assert not dat.isassigned(dd, len(dd))
+    assert not dat.isassigned(dd, 0, 0)
+
+
+def test_isassigned_wrong_type():
+    with pytest.raises(TypeError):
+        dat.isassigned(np.zeros(3), 0)
+
+
+# ---------------------------------------------------------------------------
+# advanced-indexing result shapes (SubDArray.shape must follow numpy/jax
+# broadcasting of array indices)
+# ---------------------------------------------------------------------------
+
+
+def test_subdarray_shape_two_array_indices(rng):
+    A = rng.standard_normal((8, 6)).astype(np.float32)
+    d = dat.distribute(A)
+    i1 = np.array([0, 3])
+    i2 = np.array([1, 4])
+    v = d[i1, i2]
+    assert v.shape == A[i1, i2].shape  # (2,), not (2, 2)
+    np.testing.assert_allclose(np.asarray(v), A[i1, i2])
+
+
+def test_subdarray_shape_array_and_int(rng):
+    A = rng.standard_normal((8, 6)).astype(np.float32)
+    d = dat.distribute(A)
+    i1 = np.array([0, 3, 5])
+    v = d[i1, 2]
+    assert v.shape == A[i1, 2].shape
+    np.testing.assert_allclose(np.asarray(v), A[i1, 2])
+
+
+def test_subdarray_shape_separated_array_indices(rng):
+    A = rng.standard_normal((8, 6, 4)).astype(np.float32)
+    d = dat.distribute(A)
+    i1 = np.array([0, 3])
+    i2 = np.array([1, 2])
+    v = d[i1, :, i2]  # separated advanced indices -> broadcast dims first
+    assert v.shape == A[i1, :, i2].shape
+    np.testing.assert_allclose(np.asarray(v), A[i1, :, i2])
+
+
+def test_subdarray_shape_array_with_slice(rng):
+    A = rng.standard_normal((8, 6)).astype(np.float32)
+    d = dat.distribute(A)
+    i1 = np.array([[0, 3], [2, 5]])  # 2-d array index
+    v = d[i1, :]
+    assert v.shape == A[i1, :].shape
+    np.testing.assert_allclose(np.asarray(v), A[i1, :])
+
+
+def test_subdarray_int_and_array_separated(rng):
+    # int + slice + array index: materialize must follow the same numpy
+    # advanced-indexing rules _result_shape promises for .shape
+    A = rng.standard_normal((8, 6, 4)).astype(np.float32)
+    d = dat.distribute(A)
+    v = d[2, :, np.array([1, 2])]
+    want = A[2, :, np.array([1, 2])]
+    assert v.shape == want.shape
+    np.testing.assert_allclose(np.asarray(v), want)

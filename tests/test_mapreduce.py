@@ -182,3 +182,102 @@ def test_reduce_on_subdarray(rng):
     d = dat.distribute(A)
     v = d[5:25, 10:20]
     assert np.allclose(float(dat.dsum(v)), A[5:25, 10:20].sum(), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# arbitrary binary-op reduce (reference mapreduce.jl:17-35 accepts any
+# associative op; VERDICT round-1 gap #26)
+# ---------------------------------------------------------------------------
+
+
+def test_dreduce_binary_traced_min(rng):
+    import functools
+    A = rng.standard_normal((50, 7)).astype(np.float32)
+    d = dat.distribute(A)
+    op = lambda a, b: jnp.minimum(a, b) * 1
+    got = float(dat.dreduce(op, d))
+    want = functools.reduce(lambda a, b: min(a, b), A.reshape(-1).tolist())
+    assert got == np.float32(want)
+
+
+def test_dreduce_binary_operator_add_ints():
+    import operator
+    A = np.arange(1, 101, dtype=np.int32).reshape(10, 10)
+    d = dat.distribute(A)
+    got = int(dat.dreduce(operator.add, d))
+    assert got == A.sum()
+
+
+def test_dreduce_binary_with_dims(rng):
+    A = rng.standard_normal((12, 5)).astype(np.float32)
+    d = dat.distribute(A)
+    r = dat.dreduce(lambda a, b: jnp.maximum(a, b), d, dims=0)
+    want = A.max(axis=0, keepdims=True)
+    assert r.dims == want.shape
+    np.testing.assert_array_equal(np.asarray(r), want)
+
+
+def test_dmapreduce_binary_abs2_max(rng):
+    A = rng.standard_normal((40,)).astype(np.float32)
+    d = dat.distribute(A)
+    got = float(dat.dmapreduce(lambda x: x * x, lambda a, b: jnp.maximum(a, b), d))
+    assert got == np.float32((A * A).max())
+
+
+def test_dreduce_binary_untraceable_host_fallback():
+    # an op XLA cannot trace (Python float branching) takes the host fold
+    import functools
+    A = np.arange(1, 21, dtype=np.float32)
+    d = dat.distribute(A)
+    def op(a, b):
+        fa, fb = float(a), float(b)  # forces concretization -> untraceable
+        return fa if fa > fb else fb
+    got = dat.dreduce(op, d)
+    assert float(got) == functools.reduce(op, A.tolist())
+
+
+def test_dreduce_binary_empty_raises():
+    d = dat.dzeros((0,), dtype=np.float32)
+    with pytest.raises(ValueError):
+        dat.dreduce(lambda a, b: a + b, d)
+
+
+def test_dreduce_named_ops_still_work(rng):
+    # the binary-op detection must not capture jnp-style reducers
+    A = rng.standard_normal((20, 4)).astype(np.float32)
+    d = dat.distribute(A)
+    assert np.allclose(float(dat.dreduce("sum", d)), A.sum(), rtol=1e-4)
+    assert np.allclose(float(dat.dreduce(jnp.sum, d)), A.sum(), rtol=1e-4)
+
+
+def test_dreduce_binary_noncommutative_matches_left_fold():
+    # associative but NOT commutative: "first non-nan" — the tree fold must
+    # pair adjacent operands (order-preserving), matching a left fold
+    import functools
+    A = np.array([np.nan, 2.0, 3.0, np.nan, 5.0], dtype=np.float32)
+    d = dat.distribute(A)
+    op = lambda a, b: jnp.where(jnp.isnan(a), b, a)
+    got = float(dat.dreduce(op, d))
+    want = functools.reduce(lambda a, b: b if np.isnan(a) else a, A.tolist())
+    assert got == np.float32(want) == np.float32(2.0)
+
+
+def test_dreduce_binary_untraceable_with_dims():
+    # scalar-only Python op + dims: host fold applies per kept position
+    import functools
+    A = np.arange(24, dtype=np.float32).reshape(4, 6)
+    d = dat.distribute(A)
+    def op(a, b):
+        return float(a) if float(a) > float(b) else float(b)
+    r = dat.dreduce(op, d, dims=0)
+    want = A.max(axis=0, keepdims=True)
+    assert r.dims == want.shape
+    np.testing.assert_array_equal(np.asarray(r), want)
+
+
+def test_dreduce_numpy_ufunc_binary():
+    # np.ufunc has no inspectable signature; nin==2 must route it binary
+    A = np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+    d = dat.distribute(A)
+    assert float(dat.dreduce(np.maximum, d)) == A.max()
+    assert np.isclose(float(dat.dreduce(np.add, d)), A.sum())

@@ -1,0 +1,143 @@
+"""Physical storage of uneven layouts (VERDICT round-1 item 2).
+
+The reference stores uneven chunks distributed (darray.jl:279-296,
+test/darray.jl:61-67).  Round 1 replicated any non-divisible dimension
+across its mesh axis; now uneven DArrays are stored blocked-padded — one
+(max-chunk-sized) block per device — so at-rest HBM is ~1/grid per device.
+These tests pin that via ``addressable_shards`` sizes plus the semantics
+around the pad (localpart, set_localpart, scalar reads, reductions).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import layout as L
+
+
+def test_uneven_1d_storage_is_distributed(rng):
+    # defaultdist(50, 4): logical chunks 13,13,12,12 -> blocks of 13
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A, procs=[0, 1, 2, 3], dist=[4])
+    shard_sizes = {s.data.shape for s in d.garray_padded.addressable_shards}
+    assert shard_sizes == {(13,)}, shard_sizes
+    # four distinct devices each hold one block — not a 50-replica each
+    devs = {s.device for s in d.garray_padded.addressable_shards}
+    assert len(devs) == 4
+    np.testing.assert_allclose(np.asarray(d), A)
+    d.close()
+
+
+def test_uneven_2d_storage(rng):
+    A = rng.standard_normal((50, 30)).astype(np.float32)
+    d = dat.distribute(A, dist=[4, 2])
+    sizes = {s.data.shape for s in d.garray_padded.addressable_shards}
+    assert sizes == {(13, 15)}, sizes
+    np.testing.assert_allclose(np.asarray(d), A)
+    d.close()
+
+
+def test_uneven_localpart_hits_addressable_shard(rng):
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A, procs=[0, 1, 2, 3], dist=[4])
+    cuts = d.cuts[0]
+    assert cuts == [0, 13, 26, 38, 50]  # reference leading-remainder cuts
+    for k in range(4):
+        lp = d.localpart(k)
+        assert lp.shape == (cuts[k + 1] - cuts[k],)
+        np.testing.assert_allclose(np.asarray(lp), A[cuts[k]:cuts[k + 1]])
+        # fast path: the chunk must come off ONE device, not a gather
+        assert len(lp.devices()) == 1
+    d.close()
+
+
+def test_uneven_set_localpart_and_pad_stays_zero(rng):
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A, procs=[0, 1, 2, 3], dist=[4])
+    new2 = np.full(12, 7.0, dtype=np.float32)
+    d.set_localpart(new2, pid=2)
+    B = np.asarray(d)
+    np.testing.assert_allclose(B[26:38], new2)
+    np.testing.assert_allclose(B[:26], A[:26])
+    np.testing.assert_allclose(B[38:], A[38:])
+    # the pad region must still be zero so sums over the padded buffer of
+    # future ops can't be polluted
+    padded = np.asarray(jax.device_get(d.garray_padded))
+    assert padded.shape == (52,)
+    np.testing.assert_allclose(padded[26 + 12:39], 0.0)  # block 2's pad row
+    d.close()
+
+
+def test_uneven_scalar_read(rng):
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A, dist=[4])
+    with dat.allowscalar(True):
+        for i in (0, 12, 13, 37, 38, 49):
+            assert float(d[i]) == A[i]
+    d.close()
+
+
+def test_uneven_reductions_ignore_pad(rng):
+    A = (rng.standard_normal((50, 6)) + 3.0).astype(np.float32)  # strictly >0
+    d = dat.distribute(A, dist=[4, 2])
+    assert np.allclose(float(dat.dsum(d)), A.sum(), rtol=1e-4)
+    assert np.allclose(float(dat.dminimum(d)), A.min())  # pad zeros invisible
+    r = dat.dsum(d, dims=0)
+    np.testing.assert_allclose(np.asarray(r), A.sum(0, keepdims=True),
+                               rtol=1e-4)
+    d.close()
+
+
+def test_uneven_elementwise_roundtrip(rng):
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A, dist=[4])
+    r = dat.dmap(jnp.cos, d) + d * 2.0
+    np.testing.assert_allclose(np.asarray(r), np.cos(A) + A * 2.0, rtol=1e-5)
+    # the result is again physically blocked (storage stays ~1/grid)
+    assert {s.data.shape for s in r.garray_padded.addressable_shards} == {(13,)}
+    dat.d_closeall()
+
+
+def test_uneven_fill_and_rand(rng):
+    d = dat.distribute(rng.standard_normal(50).astype(np.float32), dist=[4])
+    d.fill_(5.0)
+    np.testing.assert_allclose(np.asarray(d), 5.0)
+    padded = np.asarray(jax.device_get(d.garray_padded))
+    # block 3 = padded[39:52], valid extent 12 (chunk [38,50)) -> pad [51:52]
+    np.testing.assert_allclose(padded[51:52], 0.0)
+    d.rand_()
+    v = np.asarray(d)
+    assert v.shape == (50,) and len(np.unique(v)) > 10
+    d.close()
+
+
+def test_even_layout_has_no_padding(rng):
+    d = dat.distribute(rng.standard_normal((48, 8)).astype(np.float32))
+    assert d.garray_padded is d.garray  # no separate padded buffer
+    d.close()
+
+
+def test_empty_chunks_more_ranks_than_elems():
+    # sz < nc: leading singleton chunks, trailing empty (defaultdist_1d)
+    A = np.arange(3, dtype=np.float32)
+    d = dat.distribute(A, procs=list(range(8)), dist=[8])
+    np.testing.assert_allclose(np.asarray(d), A)
+    assert d.localpart(7).shape == (0,)
+    assert d.localpart(1).shape == (1,)
+    assert float(dat.dsum(d)) == 3.0
+    d.close()
+
+
+def test_from_chunks_irregular_sizes_distributed(rng):
+    # from_chunks builds arbitrary cut vectors (e.g. sort results)
+    parts = [rng.standard_normal(n).astype(np.float32) for n in (5, 9, 2, 4)]
+    d = dat.from_chunks(parts, procs=[0, 1, 2, 3])
+    np.testing.assert_allclose(np.asarray(d), np.concatenate(parts))
+    sizes = {s.data.shape for s in d.garray_padded.addressable_shards}
+    assert sizes == {(9,)}  # block size = max chunk
+    for k, p in enumerate(parts):
+        np.testing.assert_allclose(np.asarray(d.localpart(k)), p)
+    d.close()

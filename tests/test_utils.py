@@ -115,7 +115,11 @@ def test_multihost_single_process():
     with pytest.raises(ValueError):
         multihost.global_mesh((3, 2), ("a", "b"))
     multihost.sync_hosts()   # no-op single process
-    multihost.initialize()   # no-op single process
+    # a live backend is a real user error and must surface (round 1
+    # swallowed it); the degrade-gracefully paths are covered by
+    # test_multihost.py in fresh subprocesses
+    with pytest.raises(RuntimeError):
+        multihost.initialize()
 
 
 def test_host_local_slice(rng):
