@@ -290,6 +290,48 @@ def main():
         details["flash_attn_error"] = f"{type(e).__name__}: {e}"
     _save(details)
 
+    # ---- extra: fused (Pallas) vs einsum ring-attention hop --------------
+    # One chip = a 1-rank ring, so this isolates the per-hop compute the
+    # ring pipelines against ppermute: the fused path must be >= the
+    # einsum composition (VERDICT round-2 item 7).
+    try:
+        from distributedarrays_tpu import layout as L
+        from distributedarrays_tpu.models.ring_attention import (
+            ring_attention_kernel, ring_flash_attention_kernel)
+        from jax.sharding import PartitionSpec as RP
+        SR, HR, DR = 8192, 8, 64
+        mesh1 = L.mesh_for([0], (1,))
+        ax = mesh1.axis_names[0]
+        qr = jax.random.normal(jax.random.key(2), (SR, HR, DR), jnp.bfloat16)
+
+        def ring_len(kernel):
+            shm = jax.shard_map(
+                lambda a, b, c: kernel(a, b, c, ax, causal=True),
+                mesh=mesh1, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
+                check_vma=False)
+
+            def run(Ln):
+                @jax.jit
+                def f(qq):
+                    def body(c, _):
+                        return shm(c, qq, qq), None
+                    c, _ = lax.scan(body, qq, None, length=Ln)
+                    return jnp.sum(c.astype(jnp.float32))
+                float(f(qr))
+                return min(_t(lambda: float(f(qr))) for _ in range(2))
+            return run
+
+        t_fused = _marginal(ring_len(ring_flash_attention_kernel),
+                            L0=4, min_delta=0.05)
+        t_einsum = _marginal(ring_len(ring_attention_kernel),
+                             L0=4, min_delta=0.05)
+        details["ring_hop_fused_8k_bf16_s"] = t_fused
+        details["ring_hop_einsum_8k_bf16_s"] = t_einsum
+        details["ring_hop_fused_speedup"] = t_einsum / t_fused
+    except Exception as e:  # pragma: no cover
+        details["ring_hop_error"] = f"{type(e).__name__}: {e}"
+    _save(details)
+
     # ---- extra: distributed sort over 1e7 elements -----------------------
     try:
         from distributedarrays_tpu.ops.sort import dsort

@@ -40,7 +40,9 @@ from jax.sharding import PartitionSpec as P
 from .. import layout as L
 from ..darray import DArray, _wrap_global
 
-__all__ = ["ring_attention", "ring_attention_kernel", "reference_attention"]
+__all__ = ["ring_attention", "ring_attention_kernel",
+           "ring_flash_attention", "ring_flash_attention_kernel",
+           "reference_attention"]
 
 
 def ring_attention_kernel(q, k, v, axis: str, causal: bool = False,
@@ -129,6 +131,102 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
             f"1-D grid; got grid {q.pids.shape} for dims {q.dims}")
     mesh = L.mesh_for(pids, (n, 1, 1))
     out = _ring_jit(mesh, causal)(q.garray, k.garray, v.garray)
+    return _wrap_global(out, procs=pids, dist=[n, 1, 1])
+
+
+def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
+                                scale: float | None = None,
+                                block_q: int = 128, block_k: int = 128,
+                                interpret: bool | None = None):
+    """Fused ring attention: each hop's blockwise accumulate is ONE Pallas
+    flash program (VMEM-resident online softmax, no (h, b, b) score
+    materialization in HBM) and the online-softmax carry (m, l, acc) flows
+    around the ``ppermute`` ring.  XLA schedules the next hop's K/V
+    permute concurrently with the current hop's kernel, overlapping ICI
+    with MXU compute (VERDICT round-2 item 7 / design.md round-2 item 5).
+
+    q, k, v: ``(block, heads, d)`` — the calling rank's sequence block,
+    inside ``shard_map``.  Forward-only (use ``ring_attention_kernel`` for
+    the differentiable path).
+    """
+    from ..ops.pallas_attention import flash_attention_hop
+
+    nblk = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, h, dh = q.shape
+    sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
+
+    # kernel layout is (heads, block, d); transpose once, ring-permute the
+    # transposed buffers
+    qh = jnp.transpose(q, (1, 0, 2))
+    kh = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    m0 = jnp.full((h, b), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((h, b), jnp.float32)
+    a0 = jnp.zeros((h, b, dh), jnp.float32)
+    perm = [(i, (i + 1) % nblk) for i in range(nblk)]
+    qoff = me * b
+
+    def hop(step, m, l, a, kc, vc):
+        koff = ((me - step) % nblk) * b
+        return flash_attention_hop(qh, kc, vc, m, l, a, qoff, koff,
+                                   causal=causal, scale=sc,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret)
+
+    def body(step, carry):
+        m, l, a, kc, vc = carry
+        m, l, a = hop(step, m, l, a, kc, vc)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return m, l, a, kc, vc
+
+    m, l, a, kc, vc = lax.fori_loop(0, nblk - 1, body, (m0, l0, a0, kh, vh))
+    m, l, a = hop(nblk - 1, m, l, a, kc, vc)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (a / l[:, :, None]).astype(q.dtype)                # (h, b, dh)
+    return jnp.transpose(out, (1, 0, 2))                     # (b, h, dh)
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_flash_jit(mesh, causal: bool, block_q: int, block_k: int):
+    axis = mesh.axis_names[0]
+    spec = P(axis, None, None)
+
+    def fn(q, k, v):
+        return ring_flash_attention_kernel(q, k, v, axis, causal=causal,
+                                           block_q=block_q, block_k=block_k)
+
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec, check_vma=False))
+
+
+def ring_flash_attention(q: DArray, k: DArray, v: DArray,
+                         causal: bool = False, block_q: int = 128,
+                         block_k: int = 128) -> DArray:
+    """Fused (Pallas per-hop) exact attention over sequence-sharded
+    (seq, heads, d) DArrays — the performance path of ``ring_attention``."""
+    for name, a in (("q", q), ("k", k), ("v", v)):
+        if a.ndim != 3:
+            raise ValueError(f"{name} must be (seq, heads, head_dim), "
+                             f"got {a.dims}")
+        if a.dims != q.dims:
+            raise ValueError("q, k, v dims must match")
+    pids = [int(p) for p in q.pids.flat]
+    n = len(pids)
+    if q.pids.shape[0] != n or q.dims[0] % n != 0:
+        raise ValueError(
+            "ring attention needs the sequence dim sharded evenly over a "
+            f"1-D grid; got grid {q.pids.shape} for dims {q.dims}")
+    blk = q.dims[0] // n
+    bq = min(block_q, blk)
+    bk = min(block_k, blk)
+    while blk % bq:
+        bq //= 2
+    while blk % bk:
+        bk //= 2
+    mesh = L.mesh_for(pids, (n, 1, 1))
+    out = _ring_flash_jit(mesh, causal, bq, bk)(q.garray, k.garray, v.garray)
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])
 
 

@@ -265,6 +265,125 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
     return jax.jit(dq_call), jax.jit(dkv_call)
 
 
+# ---------------------------------------------------------------------------
+# carry-in/carry-out flash kernel: one ring-attention hop.  The online-
+# softmax state (m, l, acc) enters and leaves as HBM arrays so it can flow
+# around the ppermute ring; global q/k offsets arrive as scalars because a
+# rank's blocks sit at traced (axis_index-dependent) global positions.
+# ---------------------------------------------------------------------------
+
+
+def _carry_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, m_in_ref,
+                  l_in_ref, acc_in_ref, m_out_ref, l_out_ref, acc_out_ref,
+                  m_s, l_s, acc_s, *, scale, causal, bq, bk, k_steps):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[:] = m_in_ref[0][:, None]
+        l_s[:] = l_in_ref[0][:, None]
+        acc_s[:] = acc_in_ref[0]
+
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+    v = v_ref[0].astype(jnp.float32)                  # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+    if causal:
+        qoff = qoff_ref[0, 0]
+        koff = koff_ref[0, 0]
+        qpos = qoff + qi * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 0)
+        kpos = koff + ki * bk + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
+        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+
+    m_prev = m_s[:]
+    blk_max = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, blk_max)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_s[:] = m_new
+
+    @pl.when(ki == k_steps - 1)
+    def _flush():
+        m_out_ref[0] = m_s[:][:, 0]
+        l_out_ref[0] = l_s[:][:, 0]
+        acc_out_ref[0] = acc_s[:]
+
+
+@functools.lru_cache(maxsize=64)
+def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret):
+    if pltpu is None:
+        raise RuntimeError("pallas TPU namespace unavailable")
+    k_steps = b // bk
+    kern = functools.partial(_carry_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk, k_steps=k_steps)
+    call = pl.pallas_call(
+        kern,
+        grid=(h, b // bq, k_steps),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda hh, qi, ki: (0, 0)),           # qoff
+            pl.BlockSpec((1, 1), lambda hh, qi, ki: (0, 0)),           # koff
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # v
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # m_in
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # l_in
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # acc
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),
+            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),
+            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((h, b), jnp.float32),
+            jax.ShapeDtypeStruct((h, b), jnp.float32),
+            jax.ShapeDtypeStruct((h, b, d), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+    return call
+
+
+def flash_attention_hop(q, k, v, m, l, acc, qoff, koff,
+                        causal: bool = False, scale: float | None = None,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: bool | None = None):
+    """One ring hop of flash attention with explicit online-softmax carry.
+
+    q/k/v: ``(H, B, D)`` blocks (B = per-rank sequence block); m/l/acc:
+    the running max/normalizer/accumulator from previous hops; qoff/koff:
+    global sequence offsets of the q and k blocks (traced scalars — a
+    rank's position in the ring is ``lax.axis_index``-dependent).  Returns
+    updated (m, l, acc).  Finalize with ``acc / l`` after the last hop.
+    """
+    H, B, D = q.shape
+    bq, bk = min(block_q, B), min(block_k, B)
+    if B % bq or B % bk:
+        raise ValueError(f"block sizes ({bq}, {bk}) must divide block {B}")
+    if interpret is None:
+        interpret = not _on_tpu()
+    sc = float(1.0 / np.sqrt(D) if scale is None else scale)
+    call = _build_carry(H, B, D, bq, bk, str(q.dtype), sc, bool(causal),
+                        bool(interpret))
+    qo = jnp.asarray(qoff, jnp.int32).reshape(1, 1)
+    ko = jnp.asarray(koff, jnp.int32).reshape(1, 1)
+    return call(qo, ko, q, k, v, m, l, acc)
+
+
 def _dense_attention_shd(q, k, v, causal: bool, scale: float):
     """Dense jnp attention with EXACTLY the kernel's semantics (f32 softmax,
     (S, H, D) layout) — used as the differentiation rule for the kernel."""

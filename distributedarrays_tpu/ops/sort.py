@@ -5,7 +5,8 @@ reference implements sample-sort over RemoteChannels: local sort + ≤512
 samples (sort.jl:3-14), boundary selection on the caller (62-82), then an
 np² all-to-all where each worker put!s per-destination ranges into remote
 channels and merges what it take!s (17-60), finally rebuilding a DArray
-with a *changed, possibly uneven* distribution (164-169).
+with a *changed, possibly uneven* distribution, dropping empty parts
+(164-169).
 
 Two TPU paths:
 
@@ -13,12 +14,16 @@ Two TPU paths:
   sampling) compiled as ONE shard_map program: local ``jnp.sort`` → regular
   samples → ``all_gather`` → pivots → bucketize → ``lax.all_to_all`` (the
   np² channel scatter becomes one ICI collective) → local merge.  Ragged
-  bucket sizes are handled with +∞ padding inside the static-shape program;
-  the host trims each rank's valid prefix and rebuilds the (uneven) result
-  layout with ``from_chunks`` — same observable semantics as the reference:
-  the result's distribution generally differs from the input's.
-- default — one jitted global ``jnp.sort`` (XLA's distributed sort).
-  Supports ``by`` (key function) and ``rev``.
+  bucket sizes are handled with max-sentinel padding inside the
+  static-shape program; the host trims each rank's valid prefix, drops
+  empty chunks like the reference, and rebuilds the (uneven) result layout
+  with ``from_chunks``.  Floating data is sorted in a bit-twiddled total
+  order (sign-flip transform on the raw bits, NaNs canonicalized to sort
+  last) so NaNs and the pad sentinel coexist correctly; ``by`` sorts
+  traced keys and permutes the values through the same all_to_all.
+- default — one jitted global ``jnp.sort`` (XLA's distributed sort), plus
+  a host ``sorted(key=by)`` fallback for untraceable ``by`` callables —
+  the moral equivalent of the reference's arbitrary Julia ``by``.
 
 ``sample`` kwarg is accepted for reference API parity (sort.jl:103-170);
 PSRS uses regular sampling (p samples/rank), which subsumes the reference's
@@ -34,82 +39,138 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from .. import layout as L
 from ..darray import DArray, SubDArray, _wrap_global, distribute, from_chunks
-from .broadcast import _unwrap
 
 __all__ = ["dsort"]
 
 
 @functools.lru_cache(maxsize=64)
 def _global_sort_jit(by, rev):
+    # same key transform as PSRS, so both paths agree on NaN placement and
+    # on stable tie order under rev (flip-after-sort would reverse ties)
     def fn(x):
-        if by is not None:
-            order = jnp.argsort(by(x), stable=True)
-            s = x[order]
-        else:
-            s = jnp.sort(x)
-        return jnp.flip(s) if rev else s
+        k = x if by is None else by(x)
+        kt, _ = _sort_keys(k, np.dtype(k.dtype), rev)
+        return x[jnp.argsort(kt, stable=True)]
     return jax.jit(fn)
 
 
-@functools.lru_cache(maxsize=None)
-def _has_nan_jit():
-    return jax.jit(lambda x: jnp.any(jnp.isnan(x)))
+# ---------------------------------------------------------------------------
+# total-order transform: float -> unsigned int, monotone, NaN last
+# ---------------------------------------------------------------------------
+
+_UINTS = {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
-def _pad_value(dtype):
+def _key_uint(dtype: np.dtype):
+    return _UINTS[np.dtype(dtype).itemsize]
+
+
+def _to_total_order(x, dtype: np.dtype):
+    """IEEE-754 sign-flip transform: negative floats get all bits flipped,
+    non-negative get the sign bit set — a strictly monotone map onto
+    unsigned ints.  NaNs are canonicalized first so every NaN maps above
+    +inf (numpy's NaN-last order) yet below the all-ones pad sentinel."""
+    ui = _key_uint(dtype)
+    w = np.dtype(dtype).itemsize * 8
+    x = jnp.where(jnp.isnan(x), jnp.array(jnp.nan, dtype), x)
+    b = lax.bitcast_convert_type(x, ui)
+    sign = ui(1 << (w - 1)) if w < 64 else jnp.uint64(1) << jnp.uint64(63)
+    return jnp.where((b & sign) != 0, ~b, b | sign)
+
+
+def _sort_keys(k, dtype: np.dtype, rev: bool):
+    """Transformed sort keys: an unsigned total order for any sortable
+    dtype (floats sign-flipped with NaNs canonicalized last, signed ints
+    xor sign bit, bools as 0/1).  ``rev`` complements the bits — a
+    monotone order reversal that keeps the subsequent stable sorts stable
+    (equal keys retain original order, matching ``sorted(reverse=True)``).
+    The pad sentinel is the all-ones key; genuine all-ones keys are
+    disambiguated by the validity flag in the merge lexsort."""
     if jnp.issubdtype(dtype, jnp.floating):
-        return jnp.array(jnp.inf, dtype)
-    return jnp.array(jnp.iinfo(np.dtype(dtype)).max, dtype)
+        kt = _to_total_order(k, dtype)
+    elif dtype == np.bool_:
+        kt = k.astype(jnp.uint8)
+    elif jnp.issubdtype(dtype, jnp.signedinteger):
+        ui = _key_uint(dtype)
+        w = np.dtype(dtype).itemsize * 8
+        sign = ui(1 << (w - 1)) if w < 64 else jnp.uint64(1) << jnp.uint64(63)
+        kt = lax.bitcast_convert_type(k, ui) ^ sign
+    else:  # unsigned
+        kt = k
+    if rev:
+        kt = ~kt
+    pad = jnp.array(np.iinfo(np.dtype(kt.dtype)).max, kt.dtype)
+    return kt, pad
 
 
-def _psrs_sort(d: DArray, rev: bool) -> DArray:
+def _psrs_sort(d: DArray, rev: bool, by=None) -> DArray:
     pids = [int(q) for q in d.pids.flat]
     p = len(pids)
     n = d.dims[0]
     m = n // p
     mesh = L.mesh_for(pids, (p,))
-    # the shard_map axis name is d0 in our cached meshes
-    merged, nvalid = _psrs_mesh_jit(mesh, p, m, str(d.dtype))(d.garray)
+    merged, nvalid = _psrs_mesh_jit(mesh, p, m, str(d.dtype), by, rev)(
+        d.garray)
     merged = np.asarray(merged).reshape(p, p * m)
     nvalid = np.asarray(nvalid).reshape(p)
-    chunks = np.empty((p,), dtype=object)
-    for i in range(p):
-        c = merged[i, : int(nvalid[i])]
-        chunks[i] = c[::-1] if rev else c
-    if rev:
-        chunks = chunks[::-1].copy()
-    # reference rebuilds with the changed (possibly uneven, possibly empty-
-    # chunk) distribution (sort.jl:164-169)
-    return from_chunks(chunks, procs=pids)
+    # reference rebuilds with the changed distribution and DROPS empty
+    # parts — the participating workers may shrink (sort.jl:164-169)
+    kept = [(pids[i], merged[i, : int(nvalid[i])])
+            for i in range(p) if nvalid[i] > 0]
+    if not kept:
+        kept = [(pids[0], merged[0, :0])]
+    chunks = np.empty((len(kept),), dtype=object)
+    for i, (_, c) in enumerate(kept):
+        chunks[i] = c
+    return from_chunks(chunks, procs=[pid for pid, _ in kept])
 
 
+# NOTE: cached on the identity of `by` — pass a stable callable (module-
+# level function or jnp op), not a fresh lambda per call, or every call
+# re-traces and re-compiles the SPMD program.
 @functools.lru_cache(maxsize=32)
-def _psrs_mesh_jit(mesh, p, m, dtype_str):
+def _psrs_mesh_jit(mesh, p, m, dtype_str, by, rev):
     dtype = np.dtype(dtype_str)
-    pad = _pad_value(dtype)
     axis = mesh.axis_names[0]
 
     def kernel(x):
-        xs = jnp.sort(x)
-        samp = xs[(jnp.arange(p) * m) // p]
+        # keys: the values themselves, or traced by(x), mapped into an
+        # unsigned total order (NaNs last; `rev` = complemented bits so
+        # stability is preserved under reversal)
+        k = x if by is None else by(x)
+        kt, kpad = _sort_keys(k, np.dtype(k.dtype), rev)
+        order = jnp.argsort(kt, stable=True)
+        ks, xs = kt[order], x[order]
+        samp = ks[(jnp.arange(p) * m) // p]
         allsamp = jnp.sort(lax.all_gather(samp, axis, tiled=True))
         pivots = allsamp[jnp.arange(1, p) * p]
-        bid = jnp.searchsorted(pivots, xs, side="right")
+        bid = jnp.searchsorted(pivots, ks, side="right")
         counts = jnp.bincount(bid, length=p)
         start = jnp.concatenate([jnp.zeros((1,), counts.dtype),
                                  jnp.cumsum(counts)[:-1]])
         pos = jnp.arange(m) - start[bid]
-        buf = jnp.full((p, m), pad, dtype)
-        buf = buf.at[bid, pos].set(xs)
-        recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
-                              tiled=True)
-        merged = jnp.sort(recv.reshape(-1))
+        kbuf = jnp.full((p, m), kpad, ks.dtype).at[bid, pos].set(ks)
+        vbuf = jnp.zeros((p, m), dtype).at[bid, pos].set(xs)
+        krecv = lax.all_to_all(kbuf, axis, split_axis=0, concat_axis=0,
+                               tiled=True).reshape(-1)
+        vrecv = lax.all_to_all(vbuf, axis, split_axis=0, concat_axis=0,
+                               tiled=True).reshape(-1)
+        # validity is positional: source rank s packed its counts[s] real
+        # elements at the head of its m-slot segment, so pads are exactly
+        # the tail positions — no extra collective needed.  The stable
+        # lexsort breaks key ties valid-first, so a genuine all-ones key
+        # (e.g. int max) can never be displaced by a pad slot.
         allcounts = lax.all_gather(counts, axis, tiled=False)
-        nvalid = jnp.sum(allcounts[:, lax.axis_index(axis)])
+        sent_to_me = allcounts[:, lax.axis_index(axis)]          # (p,)
+        seg = jnp.arange(p * m) // m
+        is_pad = (jnp.arange(p * m) % m) >= sent_to_me[seg]
+        morder = jnp.lexsort((is_pad, krecv))
+        merged = vrecv[morder]
+        nvalid = jnp.sum(sent_to_me)
         return merged, nvalid.reshape((1,)).astype(jnp.int32)
 
     return jax.jit(jax.shard_map(
@@ -122,12 +183,16 @@ def dsort(d, sample=True, by=None, rev: bool = False, alg: str | None = None
     """Sort a distributed vector (reference Base.sort(::DVector), sort.jl:103).
 
     - ``alg="psrs"`` forces the distributed sample-sort (requires a 1-D
-      DArray whose length divides evenly over its ranks and no ``by``).
+      DArray whose length divides evenly over its ranks, non-bool dtype,
+      and — when given — a traceable ``by``).
     - ``alg=None`` picks PSRS when eligible and the array is distributed,
-      else the jitted global sort.
+      else the jitted global sort; an untraceable Python ``by`` falls back
+      to an exact host ``sorted(key=by)`` like the reference's arbitrary
+      Julia ``by``.
     - ``sample`` is accepted for API parity; PSRS's regular sampling plays
       the role of the reference's sample strategies (sort.jl:110-135).
-    - ``by``/``rev`` mirror the reference's keyword semantics.
+    - ``by``/``rev`` mirror the reference's keyword semantics; float data
+      (including NaNs, sorted last like numpy) stays on the PSRS path.
     """
     if isinstance(d, SubDArray):
         d = d.copy()
@@ -137,23 +202,24 @@ def dsort(d, sample=True, by=None, rev: bool = False, alg: str | None = None
         raise ValueError("dsort expects a 1-D DArray (DVector)")
     pids = [int(q) for q in d.pids.flat]
     p = len(pids)
-    eligible = by is None and p > 1 and d.dims[0] % p == 0 and d.dims[0] >= p
-    # the +inf/int-max pad sentinel scheme cannot represent bool and would
-    # silently swallow NaNs (they sort past the pads); route those to the
-    # global sort, which has numpy NaN-last semantics
-    if d.dtype == jnp.bool_:
-        eligible = False
-    elif eligible and jnp.issubdtype(d.dtype, jnp.floating):
-        if bool(_has_nan_jit()(d.garray)):
-            eligible = False
-    if alg == "psrs":
-        if not eligible:
-            raise ValueError(
-                "psrs requires an evenly-divisible 1-D layout, no `by`, a "
-                "non-bool dtype, and NaN-free data "
-                f"(n={d.dims[0]}, ranks={p}, dtype={d.dtype})")
-        return _psrs_sort(d, rev)
-    if alg is None and eligible:
-        return _psrs_sort(d, rev)
-    res = _global_sort_jit(by, rev)(d.garray)
-    return _wrap_global(res, procs=pids)
+    eligible = (p > 1 and d.dims[0] % p == 0 and d.dims[0] >= p
+                and d.dtype != jnp.bool_)
+    if alg == "psrs" and not eligible:
+        raise ValueError(
+            "psrs requires an evenly-divisible 1-D layout and a non-bool "
+            f"dtype (n={d.dims[0]}, ranks={p}, dtype={d.dtype})")
+    if eligible and (alg == "psrs" or alg is None):
+        try:
+            return _psrs_sort(d, rev, by)
+        except (jax.errors.JAXTypeError, TypeError):
+            if alg == "psrs":
+                raise  # explicitly requested: surface the untraceable `by`
+    try:
+        res = _global_sort_jit(by, rev)(d.garray)
+        return _wrap_global(res, procs=pids)
+    except (jax.errors.JAXTypeError, TypeError):
+        # arbitrary Python `by` (reference sort.jl accepts any Julia
+        # callable): exact host sort, then redistribute
+        vals = list(np.asarray(d))
+        vals.sort(key=by, reverse=rev)
+        return distribute(np.asarray(vals, dtype=d.dtype), procs=pids)

@@ -118,3 +118,43 @@ def test_shape_validation(qkv):
     with pytest.raises(ValueError, match="must be"):
         RA.ring_attention(dat.dzeros((8, 8)), dat.dzeros((8, 8)),
                           dat.dzeros((8, 8)))
+
+
+# ---------------------------------------------------------------------------
+# fused (Pallas per-hop) ring attention — forward parity with the einsum
+# ring and the dense oracle (VERDICT round-2 item 7)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_flash_matches_dense(rng):
+    from distributedarrays_tpu.models.ring_attention import (
+        ring_flash_attention, reference_attention)
+    S, H, D = 64, 2, 16
+    q = rng.standard_normal((S, H, D)).astype(np.float32)
+    k = rng.standard_normal((S, H, D)).astype(np.float32)
+    v = rng.standard_normal((S, H, D)).astype(np.float32)
+    dq = dat.distribute(q, procs=range(8), dist=(8, 1, 1))
+    dk = dat.distribute(k, procs=range(8), dist=(8, 1, 1))
+    dv = dat.distribute(v, procs=range(8), dist=(8, 1, 1))
+    out = ring_flash_attention(dq, dk, dv)
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=2e-5)
+    dat.d_closeall()
+
+
+def test_ring_flash_causal_matches_einsum_ring(rng):
+    from distributedarrays_tpu.models.ring_attention import (
+        ring_flash_attention, ring_attention, reference_attention)
+    S, H, D = 64, 2, 16
+    q = rng.standard_normal((S, H, D)).astype(np.float32)
+    k = rng.standard_normal((S, H, D)).astype(np.float32)
+    v = rng.standard_normal((S, H, D)).astype(np.float32)
+    dq = dat.distribute(q, procs=range(8), dist=(8, 1, 1))
+    dk = dat.distribute(k, procs=range(8), dist=(8, 1, 1))
+    dv = dat.distribute(v, procs=range(8), dist=(8, 1, 1))
+    fused = np.asarray(ring_flash_attention(dq, dk, dv, causal=True))
+    plain = np.asarray(ring_attention(dq, dk, dv, causal=True))
+    dense = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(fused, dense, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(fused, plain, rtol=2e-4, atol=2e-5)
+    dat.d_closeall()
