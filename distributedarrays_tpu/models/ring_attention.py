@@ -136,7 +136,7 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
 
 def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
                                 scale: float | None = None,
-                                block_q: int = 128, block_k: int = 128,
+                                block_q: int = 512, block_k: int = 512,
                                 interpret: bool | None = None):
     """Fused ring attention: each hop's blockwise accumulate is ONE Pallas
     flash program (VMEM-resident online softmax, no (h, b, b) score
@@ -149,7 +149,7 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
     inside ``shard_map``.  Forward-only (use ``ring_attention_kernel`` for
     the differentiable path).
     """
-    from ..ops.pallas_attention import flash_attention_hop
+    from ..ops.pallas_attention import flash_attention_hop, flash_carry_init
 
     nblk = lax.axis_size(axis)
     me = lax.axis_index(axis)
@@ -161,9 +161,7 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
     qh = jnp.transpose(q, (1, 0, 2))
     kh = jnp.transpose(k, (1, 0, 2))
     vh = jnp.transpose(v, (1, 0, 2))
-    m0 = jnp.full((h, b), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((h, b), jnp.float32)
-    a0 = jnp.zeros((h, b, dh), jnp.float32)
+    m0, l0, a0 = flash_carry_init(h, b, dh)
     perm = [(i, (i + 1) % nblk) for i in range(nblk)]
     qoff = me * b
 
@@ -183,8 +181,9 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
 
     m, l, a, kc, vc = lax.fori_loop(0, nblk - 1, body, (m0, l0, a0, kh, vh))
     m, l, a = hop(nblk - 1, m, l, a, kc, vc)
-    l = jnp.where(l == 0.0, 1.0, l)
-    out = (a / l[:, :, None]).astype(q.dtype)                # (h, b, dh)
+    ln = l[:, :, :1]                                         # (h, b, 1)
+    ln = jnp.where(ln == 0.0, 1.0, ln)
+    out = (a / ln).astype(q.dtype)                           # (h, b, dh)
     return jnp.transpose(out, (1, 0, 2))                     # (b, h, dh)
 
 
@@ -202,8 +201,8 @@ def _ring_flash_jit(mesh, causal: bool, block_q: int, block_k: int):
 
 
 def ring_flash_attention(q: DArray, k: DArray, v: DArray,
-                         causal: bool = False, block_q: int = 128,
-                         block_k: int = 128) -> DArray:
+                         causal: bool = False, block_q: int = 512,
+                         block_k: int = 512) -> DArray:
     """Fused (Pallas per-hop) exact attention over sequence-sharded
     (seq, heads, d) DArrays — the performance path of ``ring_attention``."""
     for name, a in (("q", q), ("k", k), ("v", v)):

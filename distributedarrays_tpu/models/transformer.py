@@ -127,8 +127,11 @@ def _attention(x, blk, heads):
     # pad the sequence to a healthy block multiple (tiny or odd S would
     # force degenerate flash blocks); padded KEYS sit at positions >= S so
     # the causal mask hides them from every real query row, and padded
-    # query rows are sliced away below
-    bs = min(128, 64 if S > 32 else 32)
+    # query rows are sliced away below.  Pick the largest block whose
+    # padding waste stays under ~1/8 of S — a flat 512 would pad S=513 to
+    # 1024 and near-double the attention work
+    bs = next(b for b in (512, 256, 128, 64, 32)
+              if b == 32 or (-(-S // b) * b - S) * 8 <= S)
     Spad = -(-S // bs) * bs
 
     def fold(t):

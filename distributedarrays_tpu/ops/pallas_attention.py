@@ -41,16 +41,33 @@ except Exception:  # pragma: no cover
 
 from .pallas_gemm import _on_tpu
 
-__all__ = ["flash_attention", "flash_block_size"]
+__all__ = ["flash_attention", "flash_block_size", "flash_carry_init"]
+
+# Per-row softmax stats (running max / normalizer / logsumexp) are stored
+# broadcast across one 128-wide lane register: TPU lowering requires the
+# last two dims of every block shape to be (divisible by 8, divisible by
+# 128) or equal to the array dims, so an (h, s) array cannot be blocked
+# (1, bq).  Same layout as jax's reference TPU flash kernel
+# (pallas/ops/tpu/flash_attention.py MIN_BLOCK_SIZE).
+_LANE = 128
 
 
-def flash_block_size(S: int, cap: int = 128) -> int:
+def flash_block_size(S: int, cap: int = 512) -> int:
     """Largest power-of-two divisor of ``S``, capped — a always-valid block
     size for ``flash_attention`` (use when S is not a multiple of 128)."""
     b = 1
     while b < cap and S % (b * 2) == 0:
         b *= 2
     return b
+
+
+def _fit_block(b: int, extent: int) -> int:
+    """Clip a requested block size to the extent, then halve until it
+    divides — every sequence length keeps working when defaults grow."""
+    b = min(b, extent)
+    while extent % b:
+        b //= 2
+    return max(b, 1)
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
@@ -64,27 +81,38 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-    k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-    v = v_ref[0].astype(jnp.float32)                  # (bk, d)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bq, bk)
-    if causal:
-        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+    # causal: a k block strictly below the q block's diagonal band is fully
+    # masked — skip its matmuls entirely (the DMA still streams, but it
+    # pipelines under the unmasked blocks' compute)
+    live = (ki * bk <= qi * bq + bq - 1) if causal else (ki == ki)
 
-    m_prev = m_ref[:]                                 # (bq, 1)
-    blk_max = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, blk_max)
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_ref[:] = m_new
+    @pl.when(live)
+    def _accumulate():
+        # matmuls run at the INPUT dtype with f32 accumulation
+        # (preferred_element_type): bf16 inputs take the fast MXU passes;
+        # an astype(f32) here would silently force 4x-slower f32 passes
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]                                      # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, -jnp.inf)
+
+        m_prev = m_ref[:]                                 # (bq, 1)
+        blk_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
 
     @pl.when(ki == k_steps - 1)
     def _flush():
@@ -92,7 +120,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
         # per-row logsumexp, consumed by the backward kernels
         m_fin = jnp.where(jnp.isfinite(m_ref[:]), m_ref[:], 0.0)
-        lse_ref[0] = (m_fin + jnp.log(l))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(m_fin + jnp.log(l), (bq, _LANE))
 
 
 @functools.lru_cache(maxsize=64)
@@ -112,11 +140,11 @@ def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((h, s, d), jnp.dtype(dtype_str)),
-            jax.ShapeDtypeStruct((h, s), jnp.float32),
+            jax.ShapeDtypeStruct((h, s, _LANE), jnp.float32),
         ),
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -145,26 +173,32 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)                   # (bq, d)
-    k = k_ref[0].astype(jnp.float32)                   # (bk, d)
-    v = v_ref[0].astype(jnp.float32)                   # (bk, d)
-    do = do_ref[0].astype(jnp.float32)                 # (bq, d)
-    lse = lse_ref[0][:, None]                          # (bq, 1)
-    dd = dd_ref[0][:, None]                            # (bq, 1)
+    live = (ki * bk <= qi * bq + bq - 1) if causal else (ki == ki)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(kpos <= qpos, s, -jnp.inf)
-    p = jnp.exp(s - lse)                               # (bq, bk), exact probs
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)  # (bq, bk)
-    ds = p * (dp - dd) * scale
-    acc_ref[:] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _accumulate():
+        # native-dtype MXU passes with f32 accumulation (see _kernel)
+        q = q_ref[0]                                       # (bq, d)
+        k = k_ref[0]                                       # (bk, d)
+        v = v_ref[0]                                       # (bk, d)
+        do = do_ref[0]                                     # (bq, d)
+        lse = lse_ref[0][:, :1]                            # (bq, 1)
+        dd = dd_ref[0][:, :1]                              # (bq, 1)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, -jnp.inf)
+        p = jnp.exp(s - lse)                               # exact probs
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(ki == k_steps - 1)
     def _flush():
@@ -182,30 +216,38 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         acck_ref[:] = jnp.zeros_like(acck_ref)
         accv_ref[:] = jnp.zeros_like(accv_ref)
 
-    q = q_ref[0].astype(jnp.float32)                   # (bq, d)
-    k = k_ref[0].astype(jnp.float32)                   # (bk, d)
-    v = v_ref[0].astype(jnp.float32)                   # (bk, d)
-    do = do_ref[0].astype(jnp.float32)                 # (bq, d)
-    lse = lse_ref[0][:, None]                          # (bq, 1)
-    dd = dd_ref[0][:, None]                            # (bq, 1)
+    # causal: a q block strictly above the k block sees none of it
+    live = (qi * bq + bq - 1 >= ki * bk) if causal else (qi == qi)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if causal:
-        qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where(kpos <= qpos, s, -jnp.inf)
-    p = jnp.exp(s - lse)
-    p = jnp.where(jnp.isfinite(s), p, 0.0)             # (bq, bk)
-    # dV += P^T @ dO
-    accv_ref[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - dd) * scale                         # (bq, bk)
-    # dK += dS^T @ Q
-    acck_ref[:] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    @pl.when(live)
+    def _accumulate():
+        # native-dtype MXU passes with f32 accumulation (see _kernel)
+        q = q_ref[0]                                       # (bq, d)
+        k = k_ref[0]                                       # (bk, d)
+        v = v_ref[0]                                       # (bk, d)
+        do = do_ref[0]                                     # (bq, d)
+        lse = lse_ref[0][:, :1]                            # (bq, 1)
+        dd = dd_ref[0][:, :1]                              # (bq, 1)
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)             # (bq, bk)
+        # dV += P^T @ dO
+        accv_ref[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd) * scale                         # (bq, bk)
+        # dK += dS^T @ Q
+        acck_ref[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == q_steps - 1)
     def _flush():
@@ -229,8 +271,8 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # dO
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # lse
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # D
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((h, s, d), dtype),
@@ -247,8 +289,8 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
             pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),  # v
             pl.BlockSpec((1, bq, d), lambda hh, ki, qi: (hh, qi, 0)),  # dO
-            pl.BlockSpec((1, bq), lambda hh, ki, qi: (hh, qi)),        # lse
-            pl.BlockSpec((1, bq), lambda hh, ki, qi: (hh, qi)),        # D
+            pl.BlockSpec((1, bq, _LANE), lambda hh, ki, qi: (hh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda hh, ki, qi: (hh, qi, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),
@@ -281,40 +323,51 @@ def _carry_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, m_in_ref,
 
     @pl.when(ki == 0)
     def _init():
-        m_s[:] = m_in_ref[0][:, None]
-        l_s[:] = l_in_ref[0][:, None]
+        m_s[:] = m_in_ref[0][:, :1]
+        l_s[:] = l_in_ref[0][:, :1]
         acc_s[:] = acc_in_ref[0]
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-    k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-    v = v_ref[0].astype(jnp.float32)                  # (bk, d)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bq, bk)
     if causal:
-        qoff = qoff_ref[0, 0]
-        koff = koff_ref[0, 0]
-        qpos = qoff + qi * bq + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 0)
-        kpos = koff + ki * bk + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, bk), 1)
-        s = jnp.where(kpos <= qpos, s, -jnp.inf)
+        # skip k blocks wholly after this q block's last row: on the hops
+        # where the whole incoming K/V block is in the masked future the
+        # kernel degenerates to a copy-through
+        live = (koff_ref[0] + ki * bk
+                <= qoff_ref[0] + qi * bq + bq - 1)
+    else:
+        live = ki == ki
 
-    m_prev = m_s[:]
-    blk_max = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, blk_max)
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - m_safe)
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
-    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-    l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_s[:] = m_new
+    @pl.when(live)
+    def _accumulate():
+        # native-dtype MXU passes with f32 accumulation (see _kernel)
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]                                      # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qoff_ref[0] + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kpos = koff_ref[0] + ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, -jnp.inf)
+
+        m_prev = m_s[:]
+        blk_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
 
     @pl.when(ki == k_steps - 1)
     def _flush():
-        m_out_ref[0] = m_s[:][:, 0]
-        l_out_ref[0] = l_s[:][:, 0]
+        m_out_ref[0] = jnp.broadcast_to(m_s[:], (bq, _LANE))
+        l_out_ref[0] = jnp.broadcast_to(l_s[:], (bq, _LANE))
         acc_out_ref[0] = acc_s[:]
 
 
@@ -329,23 +382,23 @@ def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret):
         kern,
         grid=(h, b // bq, k_steps),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda hh, qi, ki: (0, 0)),           # qoff
-            pl.BlockSpec((1, 1), lambda hh, qi, ki: (0, 0)),           # koff
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # qoff
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # koff
             pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # v
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # m_in
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),        # l_in
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
             pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # acc
         ],
         out_specs=(
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),
-            pl.BlockSpec((1, bq), lambda hh, qi, ki: (hh, qi)),
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
             pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((h, b), jnp.float32),
-            jax.ShapeDtypeStruct((h, b), jnp.float32),
+            jax.ShapeDtypeStruct((h, b, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((h, b, _LANE), jnp.float32),
             jax.ShapeDtypeStruct((h, b, d), jnp.float32),
         ),
         scratch_shapes=[
@@ -360,28 +413,35 @@ def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret):
 
 def flash_attention_hop(q, k, v, m, l, acc, qoff, koff,
                         causal: bool = False, scale: float | None = None,
-                        block_q: int = 128, block_k: int = 128,
+                        block_q: int = 512, block_k: int = 512,
                         interpret: bool | None = None):
     """One ring hop of flash attention with explicit online-softmax carry.
 
     q/k/v: ``(H, B, D)`` blocks (B = per-rank sequence block); m/l/acc:
-    the running max/normalizer/accumulator from previous hops; qoff/koff:
-    global sequence offsets of the q and k blocks (traced scalars — a
-    rank's position in the ring is ``lax.axis_index``-dependent).  Returns
-    updated (m, l, acc).  Finalize with ``acc / l`` after the last hop.
+    the running max/normalizer/accumulator from previous hops (build the
+    initial carry with ``flash_carry_init`` — m and l are lane-broadcast
+    ``(H, B, _LANE)`` f32 arrays); qoff/koff: global sequence offsets of
+    the q and k blocks (traced scalars — a rank's position in the ring is
+    ``lax.axis_index``-dependent).  Returns updated (m, l, acc).
+    Finalize with ``acc / l[..., :1]`` after the last hop.
     """
     H, B, D = q.shape
-    bq, bk = min(block_q, B), min(block_k, B)
-    if B % bq or B % bk:
-        raise ValueError(f"block sizes ({bq}, {bk}) must divide block {B}")
+    bq, bk = _fit_block(block_q, B), _fit_block(block_k, B)
     if interpret is None:
         interpret = not _on_tpu()
     sc = float(1.0 / np.sqrt(D) if scale is None else scale)
     call = _build_carry(H, B, D, bq, bk, str(q.dtype), sc, bool(causal),
                         bool(interpret))
-    qo = jnp.asarray(qoff, jnp.int32).reshape(1, 1)
-    ko = jnp.asarray(koff, jnp.int32).reshape(1, 1)
+    qo = jnp.asarray(qoff, jnp.int32).reshape(1)
+    ko = jnp.asarray(koff, jnp.int32).reshape(1)
     return call(qo, ko, q, k, v, m, l, acc)
+
+
+def flash_carry_init(h: int, b: int, d: int):
+    """Initial (m, l, acc) carry for ``flash_attention_hop``."""
+    return (jnp.full((h, b, _LANE), -jnp.inf, jnp.float32),
+            jnp.zeros((h, b, _LANE), jnp.float32),
+            jnp.zeros((h, b, d), jnp.float32))
 
 
 def _dense_attention_shd(q, k, v, causal: bool, scale: float):
@@ -414,7 +474,9 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
     out, lse = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
                       interpret)(qh, kh, vh)
     o = jnp.transpose(out, (1, 0, 2))
-    return o, (q, k, v, o, lse)
+    # keep only one lane of the lane-broadcast lse in the residuals —
+    # (H, S) instead of (H, S, 128); rebroadcast in the backward like dd
+    return o, (q, k, v, o, lse[:, :, 0])
 
 
 def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
@@ -425,9 +487,12 @@ def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
     S, H, D = q.shape
     qh, kh, vh, doh = (jnp.transpose(x, (1, 0, 2)).astype(q.dtype)
                        for x in (q, k, v, g))
-    # D_i = rowsum(dO ∘ O), per (head, row)
+    # D_i = rowsum(dO ∘ O), per (head, row); lane-broadcast both stats for
+    # the kernels' (1, bq, _LANE) block layout
     dd = jnp.einsum("shd,shd->hs", g.astype(jnp.float32),
                     o.astype(jnp.float32))
+    dd = jnp.broadcast_to(dd[:, :, None], (H, S, _LANE))
+    lse = jnp.broadcast_to(lse[:, :, None], (H, S, _LANE))
     dq_call, dkv_call = _build_bwd(H, S, D, bq, bk, str(q.dtype), scale,
                                    causal, interpret)
     dq = dq_call(qh, kh, vh, doh, lse, dd)
@@ -440,24 +505,21 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool | None = None):
     """Exact attention over (seq, heads, head_dim) arrays without
     materializing the S×S score matrix.
 
-    Block sizes must divide the sequence length (blocks are clipped to S).
-    Use as the per-rank compute inside ring attention, or standalone
-    single-chip.
+    Block sizes are fitted to the sequence length (clipped, then halved
+    until they divide S).  Use as the per-rank compute inside ring
+    attention, or standalone single-chip.
     """
     q, k, v = (jnp.asarray(x) for x in (q, k, v))
     if q.shape != k.shape or q.shape != v.shape or q.ndim != 3:
         raise ValueError(f"q/k/v must share (S, H, D), got {q.shape}, "
                          f"{k.shape}, {v.shape}")
     S, H, D = q.shape
-    bq, bk = min(block_q, S), min(block_k, S)
-    if S % bq or S % bk:
-        raise ValueError(
-            f"block sizes ({bq}, {bk}) must divide seq len {S}")
+    bq, bk = _fit_block(block_q, S), _fit_block(block_k, S)
     if interpret is None:
         interpret = not _on_tpu()
     sc = float(1.0 / np.sqrt(D) if scale is None else scale)

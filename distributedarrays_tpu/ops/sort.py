@@ -208,18 +208,28 @@ def dsort(d, sample=True, by=None, rev: bool = False, alg: str | None = None
         raise ValueError(
             "psrs requires an evenly-divisible 1-D layout and a non-bool "
             f"dtype (n={d.dims[0]}, ranks={p}, dtype={d.dtype})")
-    if eligible and (alg == "psrs" or alg is None):
+    # probe `by`'s traceability ONCE, up front: only the documented
+    # untraceable-`by` case may fall back (a genuine bug inside the device
+    # paths must surface, not silently re-sort globally / on host)
+    if by is None:
+        by_ok = True
+    else:
         try:
-            return _psrs_sort(d, rev, by)
-        except (jax.errors.JAXTypeError, TypeError):
-            if alg == "psrs":
-                raise  # explicitly requested: surface the untraceable `by`
-    try:
+            jax.eval_shape(by, jax.ShapeDtypeStruct((1,), d.dtype))
+            by_ok = True
+        except Exception:
+            by_ok = False
+    if not by_ok and alg == "psrs":
+        raise ValueError(
+            "psrs requires a traceable `by` (the given callable cannot be "
+            "jax-traced; omit alg= to use the exact host sorted(key=by))")
+    if by_ok and eligible and (alg == "psrs" or alg is None):
+        return _psrs_sort(d, rev, by)
+    if by_ok:
         res = _global_sort_jit(by, rev)(d.garray)
         return _wrap_global(res, procs=pids)
-    except (jax.errors.JAXTypeError, TypeError):
-        # arbitrary Python `by` (reference sort.jl accepts any Julia
-        # callable): exact host sort, then redistribute
-        vals = list(np.asarray(d))
-        vals.sort(key=by, reverse=rev)
-        return distribute(np.asarray(vals, dtype=d.dtype), procs=pids)
+    # arbitrary Python `by` (reference sort.jl accepts any Julia
+    # callable): exact host sort, then redistribute
+    vals = list(np.asarray(d))
+    vals.sort(key=by, reverse=rev)
+    return distribute(np.asarray(vals, dtype=d.dtype), procs=pids)

@@ -44,5 +44,12 @@ def test_flash_validation(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="share"):
         flash_attention(q, k[:64], v)
-    with pytest.raises(ValueError, match="divide"):
-        flash_attention(q, k, v, block_q=48)
+
+
+def test_flash_block_fitting(qkv):
+    # a non-dividing block request is fitted (halved until it divides),
+    # not rejected — every sequence length works with the defaults
+    q, k, v = qkv
+    got = np.asarray(flash_attention(q, k, v, causal=True, block_q=48))
+    want = reference_attention(q, k, v, causal=True)
+    assert np.abs(got - want).max() < 1e-5
