@@ -43,9 +43,15 @@ def _row_mesh(d: DArray):
     return L.mesh_for(pids, (n, 1)), pids
 
 
-def _stencil_kernel(axis: str):
+def _stencil_kernel(axis: str, use_pallas: bool):
     def step(block):
         lo, hi = halo_exchange(block, axis, halo=1, dim=0, wrap=False)
+        if use_pallas:
+            # single-pass VMEM-streaming kernel (ops/pallas_stencil.py):
+            # approaches the read+write bandwidth roofline where the jnp
+            # formulation below costs several HBM round-trips
+            from ..ops.pallas_stencil import stencil5_block
+            return stencil5_block(block, lo, hi)
         x = jnp.concatenate([lo, block, hi], axis=0)
         up, down = x[:-2, :], x[2:, :]
         left = jnp.concatenate([jnp.zeros_like(block[:, :1]), block[:, :-1]],
@@ -57,9 +63,9 @@ def _stencil_kernel(axis: str):
 
 
 @functools.lru_cache(maxsize=32)
-def _stencil_jit(mesh, iters: int):
+def _stencil_jit(mesh, iters: int, use_pallas: bool):
     axis = mesh.axis_names[0]
-    step = _stencil_kernel(axis)
+    step = _stencil_kernel(axis, use_pallas)
 
     def many(block):
         def body(b, _):
@@ -78,11 +84,25 @@ def stencil5_step(d: DArray) -> DArray:
     return stencil5(d, iters=1)
 
 
-def stencil5(d: DArray, iters: int = 1) -> DArray:
+def stencil5(d: DArray, iters: int = 1,
+             use_pallas: bool | None = None) -> DArray:
     """``iters`` Laplacian steps compiled as one program (lax.scan over the
-    halo-exchange step; communication = 2 ppermutes/step over ICI)."""
+    halo-exchange step; communication = 2 ppermutes/step over ICI).
+
+    ``use_pallas`` defaults to auto: the Pallas streaming kernel on TPU,
+    the jnp formulation elsewhere (pass explicitly to override; off-TPU
+    the kernel runs in interpreter mode)."""
+    if use_pallas is None:
+        from ..ops.pallas_gemm import _on_tpu
+        # the Pallas kernel needs a >=8-row divisor per rank (TPU block
+        # rule) or a whole block that fits VMEM; otherwise stay on jnp
+        mloc = d.dims[0] // d.pids.size
+        itemsize = jnp.dtype(d.dtype).itemsize
+        compatible = (mloc % 8 == 0
+                      or mloc * d.dims[1] * itemsize <= 2 * 1024 * 1024)
+        use_pallas = _on_tpu() and compatible
     mesh, pids = _row_mesh(d)
-    res = _stencil_jit(mesh, int(iters))(d.garray)
+    res = _stencil_jit(mesh, int(iters), bool(use_pallas))(d.garray)
     return _wrap_global(res, procs=pids, dist=list(d.pids.shape))
 
 

@@ -1,0 +1,119 @@
+"""Hand-written Pallas TPU kernel for the 5-point stencil hot loop.
+
+BASELINE config 4 (the reference's SPMD halo-exchange stencil,
+/root/reference/src/spmd.jl:145-184 + docs/src/index.md:160-181) is
+bandwidth-bound: one Laplacian step reads and writes the grid once, so the
+roofline is ~(HBM BW)/(8 bytes/cell).  The jnp formulation in
+models/stencil.py (concat halo + four shifted adds) costs XLA several HBM
+round-trips per step; this kernel streams each row-block through VMEM once
+— one block read, one block write, plus two single-row neighbor arrays —
+so a step approaches the 2-pass roofline.
+
+Layout trick: instead of overlapping block windows (inexpressible with
+block-granular BlockSpec index maps), the rows that cross block boundaries
+are precomputed OUTSIDE the kernel as two tiny (nblocks, n) arrays:
+
+    top_rows[i] = the row just above block i   (device halo ``lo`` for i=0)
+    bot_rows[i] = the row just below block i   (device halo ``hi`` for last)
+
+built with stride-``bm`` slices (negligible traffic), so the kernel's
+index maps are the identity and every boundary case vanishes from the
+kernel body.  The column neighbors are in-register shifts of the resident
+block.
+
+Interpreter mode runs the same kernel off-TPU for the CPU-mesh suite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_gemm import _on_tpu
+
+__all__ = ["stencil5_block"]
+
+
+def _kernel(mid_ref, top_ref, bot_ref, o_ref):
+    c = mid_ref[...]                                    # (bm, n)
+    up = jnp.concatenate([top_ref[0], c[:-1]], axis=0)
+    down = jnp.concatenate([c[1:], bot_ref[0]], axis=0)
+    z = jnp.zeros_like(c[:, :1])
+    left = jnp.concatenate([z, c[:, :-1]], axis=1)
+    right = jnp.concatenate([c[:, 1:], z], axis=1)
+    o_ref[...] = up + down + left + right - 4.0 * c
+
+
+@functools.lru_cache(maxsize=64)
+def _build(m, n, bm, dtype_str, interpret):
+    nb = m // bm
+    call = pl.pallas_call(
+        _kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((bm, n), lambda i: (i, 0)),    # resident block
+            # boundary rows carry a unit middle axis — (nb, 1, n) blocked
+            # (1, 1, n) — because a (1, n) block over an (nb, n) array
+            # violates the TPU (8, 128)-or-equal block-shape rule
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0)),  # row above i
+            pl.BlockSpec((1, 1, n), lambda i: (i, 0, 0)),  # row below i
+        ],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.dtype(dtype_str)),
+        interpret=interpret,
+    )
+    return call
+
+
+def stencil5_block(block, lo, hi, block_rows: int | None = None,
+                   interpret: bool | None = None):
+    """One 5-point Laplacian step on a local (m, n) block.
+
+    ``lo``/``hi``: the (1, n) halo rows from the neighboring ranks (zeros
+    at the outer boundary) — exactly what ``halo_exchange`` returns.
+    Semantics match models/stencil.py's jnp step: zero column boundary,
+    ``up + down + left + right - 4*center``.
+
+    ``block_rows`` defaults to whatever keeps one (bm, n) buffer around
+    2 MB — the kernel body materializes several such temporaries plus the
+    double-buffered in/out blocks, and a full-width 8192² f32 block at 512
+    rows blows the 16 MB VMEM scoped limit.
+    """
+    m, n = block.shape
+    if lo.shape != (1, n) or hi.shape != (1, n):
+        raise ValueError(f"halo rows must be (1, {n}); got {lo.shape}, "
+                         f"{hi.shape}")
+    if block_rows is None:
+        target = 2 * 1024 * 1024
+        block_rows = max(8, target // (n * block.dtype.itemsize))
+    bm = min(block_rows, m)
+    while m % bm:
+        bm //= 2
+    if bm < 8 and bm != m:
+        # a (bm<8, n) block violates the TPU (8, 128)-or-equal rule the
+        # blocked path relies on; the only escape is one whole-array block
+        # (block dims == array dims), viable when it fits VMEM
+        if m * n * block.dtype.itemsize <= 2 * 1024 * 1024:
+            bm = m
+        else:
+            raise ValueError(
+                f"stencil5_block needs the row count ({m}) to have a "
+                "divisor >= 8 within block_rows (or a block small enough "
+                "to process whole); use the jnp path (use_pallas=False) "
+                "for this layout")
+    if interpret is None:
+        interpret = not _on_tpu()
+    nb = m // bm
+    # top_rows[i] = last row of block i-1 (halo lo for i=0); bot_rows[i] =
+    # first row of block i+1 (halo hi for the last block).  Stride-bm row
+    # slices: tiny traffic, identity index maps in the kernel.
+    if nb > 1:
+        top_rows = jnp.concatenate([lo, block[bm - 1::bm][:-1]], axis=0)
+        bot_rows = jnp.concatenate([block[bm::bm], hi], axis=0)
+    else:
+        top_rows, bot_rows = lo, hi
+    return _build(m, n, bm, str(block.dtype), bool(interpret))(
+        block, top_rows[:, None, :], bot_rows[:, None, :])

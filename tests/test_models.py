@@ -35,6 +35,65 @@ def test_stencil5_multi_iter(rng):
     assert np.allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_stencil5_pallas_matches_oracle(rng):
+    # the Pallas streaming kernel (interpret mode off-TPU), including
+    # multi-block row streaming and halo rows crossing ranks
+    A = rng.standard_normal((64, 32)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(8, 1))
+    got = np.asarray(stencil.stencil5(d, use_pallas=True))
+    assert np.allclose(got, _lap(A), rtol=1e-5, atol=1e-5)
+    d2 = dat.distribute(A, procs=range(8), dist=(8, 1))
+    got3 = np.asarray(stencil.stencil5(d2, iters=3, use_pallas=True))
+    assert np.allclose(got3, _lap(_lap(_lap(A))), rtol=1e-4, atol=1e-4)
+
+
+def test_stencil5_pallas_multiblock(rng):
+    # force >1 row-block per rank so the top/bot boundary-row arrays and
+    # identity index maps are really exercised
+    from distributedarrays_tpu.ops.pallas_stencil import stencil5_block
+    A = rng.standard_normal((64, 32)).astype(np.float32)
+    lo = np.zeros((1, 32), np.float32)
+    hi = np.zeros((1, 32), np.float32)
+    got = np.asarray(stencil5_block(jnp.asarray(A), jnp.asarray(lo),
+                                    jnp.asarray(hi), block_rows=16))
+    assert np.allclose(got, _lap(A), rtol=1e-5, atol=1e-5)
+    # non-zero halos enter the first/last rows
+    lo2 = np.full((1, 32), 2.0, np.float32)
+    hi2 = np.full((1, 32), -3.0, np.float32)
+    got2 = np.asarray(stencil5_block(jnp.asarray(A), jnp.asarray(lo2),
+                                     jnp.asarray(hi2), block_rows=16))
+    want2 = _lap(A)
+    want2[0] += 2.0
+    want2[-1] += -3.0
+    assert np.allclose(got2, want2, rtol=1e-5, atol=1e-5)
+
+
+def test_stencil5_pallas_odd_rows(rng):
+    # rows with no >=8 divisor: small blocks take the whole-array escape
+    # (block dims == array dims), large ones must raise with guidance
+    from distributedarrays_tpu.ops.pallas_stencil import stencil5_block
+    A = rng.standard_normal((31, 32)).astype(np.float32)
+    z = np.zeros((1, 32), np.float32)
+    got = np.asarray(stencil5_block(jnp.asarray(A), jnp.asarray(z),
+                                    jnp.asarray(z)))
+    assert np.allclose(got, _lap(A), rtol=1e-5, atol=1e-5)
+    big = jnp.zeros((5001, 1024), jnp.float32)
+    zb = jnp.zeros((1, 1024), jnp.float32)
+    with pytest.raises(ValueError, match="use_pallas=False"):
+        stencil5_block(big, zb, zb)
+
+
+def test_pallas_matmul_auto_block_fits():
+    # the auto default must keep accepting shapes the old 256^3 default
+    # took (e.g. 1536: divisible by 256, not by 1024/512-tile clipping)
+    from distributedarrays_tpu.ops.pallas_gemm import pallas_matmul
+    a = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((1536, 1536)).astype(np.float32))
+    got = np.asarray(pallas_matmul(a, a))
+    want = np.asarray(a) @ np.asarray(a)
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
 def test_stencil_layout_requirements():
     d = dat.dzeros((50, 8), procs=range(4), dist=(4, 1))  # 50 % 4 != 0
     with pytest.raises(ValueError, match="row-sharded"):

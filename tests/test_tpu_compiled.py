@@ -1,0 +1,110 @@
+"""Compiled-on-hardware kernel checks (run with ``DAT_TEST_TPU=1``).
+
+The default suite runs every Pallas kernel in interpreter mode on the
+virtual CPU mesh; this file is the hardware leg (VERDICT round-2 item 3):
+with ``DAT_TEST_TPU=1`` and a real TPU visible, each kernel compiles
+through Mosaic and must match its dense oracle.  Single-chip by design —
+it exercises kernel lowering (block shapes, VMEM budgets, SMEM scalars),
+not cross-chip collectives (the CPU-mesh suite covers those).
+
+Skipped silently off-hardware so `pytest tests/` stays green everywhere.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("DAT_TEST_TPU") != "1":  # pragma: no cover
+    pytest.skip("hardware leg: set DAT_TEST_TPU=1 on a TPU host",
+                allow_module_level=True)
+
+from distributedarrays_tpu.ops.pallas_gemm import _on_tpu
+
+if not _on_tpu():  # pragma: no cover
+    pytest.skip("no TPU visible", allow_module_level=True)
+
+
+def test_flash_attention_compiled_fwd_bwd():
+    from distributedarrays_tpu.ops.pallas_attention import flash_attention
+    from distributedarrays_tpu.models.ring_attention import (
+        reference_attention)
+    S, H, D = 1024, 4, 64
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (S, H, D), jnp.float32)
+    v = jax.random.normal(kv, (S, H, D), jnp.float32)
+    for causal in (False, True):
+        got = np.asarray(flash_attention(q, k, v, causal=causal))
+        want = reference_attention(q, k, v, causal=causal)
+        # MXU default precision (bf16 passes) tolerance
+        assert np.abs(got - want).max() < 2e-2
+
+    def loss(q):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def dense_loss(q):
+        s = jnp.einsum("qhd,khd->hqk", q / jnp.sqrt(D), k)
+        qi = jnp.arange(S)[:, None]
+        ki = jnp.arange(S)[None, :]
+        s = jnp.where((ki <= qi)[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("hqk,khd->qhd", p, v) ** 2)
+
+    g = jax.grad(loss)(q)
+    gd = jax.grad(dense_loss)(q)
+    denom = float(jnp.abs(gd).max())
+    assert float(jnp.abs(g - gd).max()) / denom < 5e-2
+
+
+def test_flash_attention_hop_compiled():
+    from distributedarrays_tpu.ops.pallas_attention import (
+        flash_attention_hop, flash_carry_init)
+    from distributedarrays_tpu.models.ring_attention import (
+        reference_attention)
+    S, H, D = 512, 4, 64
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (S, H, D), jnp.float32)
+    v = jax.random.normal(kv, (S, H, D), jnp.float32)
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    half = S // 2
+    # rank-0 q block receives the FUTURE k block first (fully skipped),
+    # then its own — the carry must pass through the masked hop unchanged
+    m, l, a = flash_carry_init(H, half, D)
+    m, l, a = flash_attention_hop(qh[:, :half], kh[:, half:], vh[:, half:],
+                                  m, l, a, 0, half, causal=True)
+    m, l, a = flash_attention_hop(qh[:, :half], kh[:, :half], vh[:, :half],
+                                  m, l, a, 0, 0, causal=True)
+    got = np.asarray(jnp.transpose(a / l[:, :, :1], (1, 0, 2)))
+    want = reference_attention(q, k, v, causal=True)[:half]
+    assert np.abs(got - want).max() < 2e-2
+
+
+def test_pallas_matmul_compiled():
+    from distributedarrays_tpu.ops.pallas_gemm import pallas_matmul
+    for dt, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 2e-2)):
+        a = jax.random.normal(jax.random.key(2), (2048, 2048), dt)
+        b = jax.random.normal(jax.random.key(3), (2048, 2048), dt)
+        got = np.asarray(pallas_matmul(a, b)).astype(np.float32)
+        want = np.asarray(jnp.matmul(a, b)).astype(np.float32)
+        rel = np.abs(got - want).max() / np.abs(want).max()
+        assert rel < tol, (dt, rel)
+
+
+def test_pallas_stencil_compiled():
+    from distributedarrays_tpu.ops.pallas_stencil import stencil5_block
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((2048, 1024)).astype(np.float32)
+    lo = rng.standard_normal((1, 1024)).astype(np.float32)
+    hi = rng.standard_normal((1, 1024)).astype(np.float32)
+    got = np.asarray(stencil5_block(jnp.asarray(A), jnp.asarray(lo),
+                                    jnp.asarray(hi)))
+    x = np.concatenate([lo, A, hi], axis=0)
+    left = np.concatenate([np.zeros((A.shape[0], 1), A.dtype), A[:, :-1]], 1)
+    right = np.concatenate([A[:, 1:], np.zeros((A.shape[0], 1), A.dtype)], 1)
+    want = x[:-2] + x[2:] + left + right - 4 * A
+    assert np.abs(got - want).max() < 1e-4
