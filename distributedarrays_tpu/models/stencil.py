@@ -94,13 +94,10 @@ def stencil5(d: DArray, iters: int = 1,
     the kernel runs in interpreter mode)."""
     if use_pallas is None:
         from ..ops.pallas_gemm import _on_tpu
-        # the Pallas kernel needs a >=8-row divisor per rank (TPU block
-        # rule) or a whole block that fits VMEM; otherwise stay on jnp
-        mloc = d.dims[0] // d.pids.size
-        itemsize = jnp.dtype(d.dtype).itemsize
-        compatible = (mloc % 8 == 0
-                      or mloc * d.dims[1] * itemsize <= 2 * 1024 * 1024)
-        use_pallas = _on_tpu() and compatible
+        from ..ops.pallas_stencil import supports
+        use_pallas = (_on_tpu()
+                      and supports(d.dims[0] // d.pids.size, d.dims[1],
+                                   d.dtype))
     mesh, pids = _row_mesh(d)
     res = _stencil_jit(mesh, int(iters), bool(use_pallas))(d.garray)
     return _wrap_global(res, procs=pids, dist=list(d.pids.shape))

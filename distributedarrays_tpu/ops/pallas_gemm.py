@@ -111,16 +111,28 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     if block is None:
         two_byte = max(jnp.dtype(a.dtype).itemsize,
                        jnp.dtype(b.dtype).itemsize) <= 2
-        bm, bn, bk = (1024, 1024, 512) if two_byte else (512, 512, 512)
-        # auto default: fit each tile (halve until it divides) so every
-        # shape the old fixed default accepted keeps working
-        bm, bn, bk = min(bm, m), min(bn, n), min(bk, ka)
-        while m % bm:
-            bm //= 2
-        while n % bn:
-            bn //= 2
-        while ka % bk:
-            bk //= 2
+        bm0, bn0, bk0 = (1024, 1024, 512) if two_byte else (512, 512, 512)
+
+        # auto default: largest power-of-two divisor per dim under the
+        # tuned cap, so every shape the old fixed 256^3 default accepted
+        # keeps working — then check the result is MXU-tileable (TPU
+        # blocks need their last dim divisible by 128 and second-to-last
+        # by 8, or equal to the array dim) instead of dying in Mosaic
+        def fit(dim, cap):
+            if dim <= cap:       # whole dim = the always-valid equal-dims
+                return dim       # escape (and the old default's behavior)
+            bb = 1
+            while bb * 2 <= cap and dim % (bb * 2) == 0:
+                bb *= 2
+            return bb
+
+        bm, bn, bk = fit(m, bm0), fit(n, bn0), fit(ka, bk0)
+        if not ((bm % 8 == 0 or bm == m)
+                and (bn % 128 == 0 or bn == n)
+                and (bk % 128 == 0 or bk == ka)):
+            raise ValueError(
+                f"shapes ({m},{ka})x({kb},{n}) have no MXU-aligned "
+                "power-of-two tiling; pad the operands or pass block=")
     else:
         bm, bn, bk = block
         bm, bn, bk = min(bm, m), min(bn, n), min(bk, ka)

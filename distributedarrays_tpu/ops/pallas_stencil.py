@@ -34,7 +34,40 @@ from jax.experimental import pallas as pl
 
 from .pallas_gemm import _on_tpu
 
-__all__ = ["stencil5_block"]
+__all__ = ["stencil5_block", "supports"]
+
+_VMEM_TARGET = 2 * 1024 * 1024  # ~per-buffer VMEM budget for (bm, n) tiles
+
+
+def _pow2_divisor(m: int, cap: int) -> int:
+    """Largest power-of-two divisor of ``m`` that is <= ``cap``."""
+    b = 1
+    while b * 2 <= cap and m % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def _plan(m: int, n: int, itemsize: int, block_rows: int | None):
+    """Resolve the row-block size, or None when no TPU-valid tiling
+    exists.  Power-of-two blocks >= 8 satisfy the (8, 128)-or-equal block
+    rule; the one escape is a single whole-array block (== array dims)
+    small enough for VMEM."""
+    if block_rows is None:
+        block_rows = max(8, _VMEM_TARGET // (n * itemsize))
+    bm = _pow2_divisor(m, min(block_rows, m))
+    if bm >= 8 or bm == m:
+        return bm
+    if m * n * itemsize <= _VMEM_TARGET:
+        return m
+    return None
+
+
+def supports(m: int, n: int, dtype) -> bool:
+    """Whether ``stencil5_block`` can tile an (m, n) block on TPU — the
+    single source of truth for routers choosing between this kernel and
+    the jnp formulation (models/stencil.py)."""
+    import jax.numpy as jnp
+    return _plan(m, n, jnp.dtype(dtype).itemsize, None) is not None
 
 
 def _kernel(mid_ref, top_ref, bot_ref, o_ref):
@@ -86,24 +119,13 @@ def stencil5_block(block, lo, hi, block_rows: int | None = None,
     if lo.shape != (1, n) or hi.shape != (1, n):
         raise ValueError(f"halo rows must be (1, {n}); got {lo.shape}, "
                          f"{hi.shape}")
-    if block_rows is None:
-        target = 2 * 1024 * 1024
-        block_rows = max(8, target // (n * block.dtype.itemsize))
-    bm = min(block_rows, m)
-    while m % bm:
-        bm //= 2
-    if bm < 8 and bm != m:
-        # a (bm<8, n) block violates the TPU (8, 128)-or-equal rule the
-        # blocked path relies on; the only escape is one whole-array block
-        # (block dims == array dims), viable when it fits VMEM
-        if m * n * block.dtype.itemsize <= 2 * 1024 * 1024:
-            bm = m
-        else:
-            raise ValueError(
-                f"stencil5_block needs the row count ({m}) to have a "
-                "divisor >= 8 within block_rows (or a block small enough "
-                "to process whole); use the jnp path (use_pallas=False) "
-                "for this layout")
+    bm = _plan(m, n, block.dtype.itemsize, block_rows)
+    if bm is None:
+        raise ValueError(
+            f"stencil5_block has no TPU-valid tiling for ({m}, {n}) "
+            f"{block.dtype}: needs a power-of-two row divisor >= 8 within "
+            "the VMEM budget, or a whole block small enough to process in "
+            "one step; use the jnp path (use_pallas=False) for this layout")
     if interpret is None:
         interpret = not _on_tpu()
     nb = m // bm

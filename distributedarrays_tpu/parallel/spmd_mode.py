@@ -46,6 +46,25 @@ __all__ = [
 _DEFAULT_TIMEOUT = 60.0  # seconds; a stuck collective fails loudly, not forever
 
 
+_PEER_ABORT = "SPMD peer task failed; aborting receive"
+
+
+def _scan_stash(msgs: list, match: Callable[[tuple], bool]):
+    """Pop and return the first stashed message satisfying ``match``
+    (out-of-order buffering, reference spmd.jl:126-143), else None.
+    Shared by the thread mailbox and the process backend's queue view."""
+    for i, m in enumerate(msgs):
+        if match(m):
+            return msgs.pop(i)
+    return None
+
+
+def _receive_timeout(timeout: float, msgs: list) -> TimeoutError:
+    return TimeoutError(
+        f"SPMD receive timed out after {timeout}s "
+        f"(pending: {[(m[0], m[1], m[3]) for m in msgs[:8]]})")
+
+
 class _Mailbox:
     """Per-(context, rank) message store with tag/type/source matching and
     out-of-order buffering (reference spmd.jl:126-143: unexpected messages
@@ -65,16 +84,14 @@ class _Mailbox:
         deadline = time.monotonic() + timeout
         with self._cond:
             while True:
-                for i, m in enumerate(self._msgs):
-                    if match(m):
-                        return self._msgs.pop(i)
+                m = _scan_stash(self._msgs, match)
+                if m is not None:
+                    return m
                 if failed.is_set():
-                    raise RuntimeError("SPMD peer task failed; aborting receive")
+                    raise RuntimeError(_PEER_ABORT)
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise TimeoutError(
-                        f"SPMD receive timed out after {timeout}s "
-                        f"(pending: {[(m[0], m[1], m[3]) for m in self._msgs[:8]]})")
+                    raise _receive_timeout(timeout, self._msgs)
                 self._cond.wait(min(remaining, 0.1))
 
 
@@ -90,6 +107,7 @@ class SPMDContext:
         self._barrier_gen: dict[int, int] = {p: 0 for p in self.pids}
         self._failed = threading.Event()
         self._release_gen = 0
+        self._proc_state = None   # process backend's persistent queues
 
     def mailbox(self, pid: int) -> _Mailbox:
         try:
@@ -103,6 +121,7 @@ class SPMDContext:
         spmd.jl:30-35,256-258)."""
         self._mailboxes = {p: _Mailbox() for p in self.pids}
         self.store = {p: {} for p in self.pids}
+        self._drop_proc_state()
 
     def _reset_comm(self):
         """Drain in-flight messages and resynchronize barrier generations
@@ -112,6 +131,17 @@ class SPMDContext:
         self._mailboxes = {p: _Mailbox() for p in self.pids}
         self._barrier_gen = {p: 0 for p in self.pids}
         self._failed = threading.Event()
+        self._drop_proc_state()
+
+    def _drop_proc_state(self):
+        """Release the process backend's persistent per-rank queues (set
+        lazily by spmd_process.run_spmd_process on explicit contexts)."""
+        st = getattr(self, "_proc_state", None)
+        if st is not None:
+            self._proc_state = None
+            for q in st["queues"].values():
+                q.close()
+                q.cancel_join_thread()
 
 
 _CONTEXTS_LOCK = threading.Lock()
@@ -289,7 +319,8 @@ def gather_spmd(x, root: int, tag: Any = None,
 
 
 def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
-         context: SPMDContext | None = None, timeout: float = 300.0):
+         context: SPMDContext | None = None, timeout: float = 300.0,
+         backend: str = "thread"):
     """Run ``f(*args)`` once per rank, concurrently (reference spmd driver,
     spmd.jl:233-254).
 
@@ -298,11 +329,32 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
     after the run, like the reference's ``clear_ctxt`` path), and DArray
     arguments resolve ``localpart()`` against the task's rank.  Returns the
     per-rank return values, pid-ordered.
+
+    ``backend="process"`` forks one OS process per rank (the reference's
+    addprocs worker model, runtests.jl:10-13): pure-Python rank compute
+    runs GIL-free, messages/results/storage cross process boundaries (and
+    must be picklable), and context storage is merged back after the run.
+    Host-side compute only — see parallel/spmd_process.py.
     """
     implicit = context is None
     ctx = SPMDContext(pids) if implicit else context
     if pids is not None and not implicit and list(pids) != ctx.pids:
         raise ValueError("pids disagree with explicit context's pids")
+    if backend == "process":
+        from .spmd_process import run_spmd_process
+        try:
+            res = run_spmd_process(f, args, ctx, timeout)
+        except BaseException:
+            if not implicit:
+                ctx._reset_comm()    # same post-failure hygiene as threads
+            raise
+        finally:
+            if implicit:
+                ctx.close()
+        return [res[p] for p in ctx.pids]
+    if backend != "thread":
+        raise ValueError(f"unknown spmd backend {backend!r} "
+                         "(expected 'thread' or 'process')")
     results: dict[int, Any] = {}
     errors: dict[int, BaseException] = {}
 

@@ -72,7 +72,8 @@ def test_single_step_helpers(rng):
 
 
 def test_flash_block_size():
-    assert flash_block_size(256) == 128
+    assert flash_block_size(256) == 256      # cap defaults to the tuned 512
+    assert flash_block_size(2048) == 512
     assert flash_block_size(96) == 32
     assert flash_block_size(31) == 1
     assert flash_block_size(64, cap=32) == 32

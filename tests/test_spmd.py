@@ -222,3 +222,131 @@ def test_outside_spmd_raises():
         S.sendto(0, "x")
     with pytest.raises(RuntimeError, match="spmd"):
         S.barrier()
+
+
+# ---------------------------------------------------------------------------
+# process backend (parallel/spmd_process.py): the reference's addprocs
+# worker model (runtests.jl:10-13) — real forked rank processes
+# ---------------------------------------------------------------------------
+
+_HAS_FORK = hasattr(__import__("os"), "fork")
+process_only = pytest.mark.skipif(not _HAS_FORK, reason="needs POSIX fork")
+
+
+@process_only
+def test_process_backend_ring():
+    def ring():
+        me = S.myid()
+        S.sendto((me + 1) % 4, ("hello", me))
+        kind, frm = S.recvfrom((me - 1) % 4)
+        assert kind == "hello"
+        S.barrier()
+        return frm
+    out = S.spmd(ring, pids=range(4), backend="process")
+    assert out == [(i - 1) % 4 for i in range(4)]
+
+
+@process_only
+def test_process_backend_gil_free_parallelism():
+    # ranks run in separate processes: os.getpid differs from the parent
+    # and (usually) between ranks
+    import os
+    parent = os.getpid()
+    pids = S.spmd(lambda: os.getpid(), pids=range(4), backend="process")
+    assert all(p != parent for p in pids)
+    assert len(set(pids)) == 4
+
+
+@process_only
+def test_process_backend_collectives():
+    def prog():
+        me = S.myid()
+        v = S.bcast("seed" if me == 1 else None, root=1)
+        part = S.scatter(list(range(8)) if me == 0 else None, root=0)
+        got = S.gather_spmd(sum(part), root=0)
+        S.barrier()
+        return (v, part, got)
+    out = S.spmd(prog, pids=range(4), backend="process")
+    assert all(v == "seed" for v, _, _ in out)
+    assert [p for _, p, _ in out] == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert out[0][2] == [1, 5, 9, 13]
+    assert all(g is None for _, _, g in out[1:])
+
+
+@process_only
+def test_process_backend_context_storage_persists():
+    ctx = S.context(pids=range(4))
+    try:
+        def first():
+            S.context_local_storage()["mine"] = S.myid() * 11
+            return True
+
+        def second():
+            return S.context_local_storage().get("mine")
+
+        assert all(S.spmd(first, context=ctx, backend="process"))
+        got = S.spmd(second, context=ctx, backend="process")
+        assert got == [0, 11, 22, 33]
+        # and the thread backend sees the merged storage too
+        got_thread = S.spmd(second, context=ctx)
+        assert got_thread == [0, 11, 22, 33]
+    finally:
+        S.close_context(ctx)
+
+
+@process_only
+def test_process_backend_failure_propagates():
+    def prog():
+        me = S.myid()
+        if me == 2:
+            raise ValueError("rank 2 exploded")
+        # other ranks block on a receive that will never arrive; the
+        # failure event must abort them instead of a 60s timeout
+        S.recvfrom(2, timeout=30)
+
+    with pytest.raises(RuntimeError, match="failed"):
+        S.spmd(prog, pids=range(4), backend="process")
+
+
+@process_only
+def test_process_backend_tagged_out_of_order():
+    def prog():
+        me = S.myid()
+        if me == 0:
+            S.sendto(1, "second", tag="b")
+            S.sendto(1, "first", tag="a")
+            return None
+        a = S.recvfrom(0, tag="a")
+        b = S.recvfrom(0, tag="b")
+        return (a, b)
+    out = S.spmd(prog, pids=range(2), backend="process")
+    assert out[1] == ("first", "second")
+
+
+@process_only
+def test_process_backend_bad_backend_name():
+    with pytest.raises(ValueError, match="backend"):
+        S.spmd(lambda: 0, pids=range(2), backend="gondola")
+
+
+@process_only
+def test_process_backend_message_survives_across_runs():
+    # thread-backend parity: a message sent but not received in one run
+    # stays in the context's inbox for the next run
+    ctx = S.context(pids=range(2))
+    try:
+        def send_only():
+            if S.myid() == 0:
+                S.sendto(1, "late delivery", tag="x")
+            return True
+
+        def recv_only():
+            if S.myid() == 1:
+                return S.recvfrom(0, tag="x", timeout=10)
+            return None
+
+        assert all(S.spmd(send_only, context=ctx, backend="process"))
+        out = S.spmd(recv_only, context=ctx, backend="process")
+        assert out[1] == "late delivery"
+    finally:
+        S.close_context(ctx)
