@@ -55,10 +55,8 @@ _LANE = 128
 def flash_block_size(S: int, cap: int = 512) -> int:
     """Largest power-of-two divisor of ``S``, capped — a always-valid block
     size for ``flash_attention`` (use when S is not a multiple of 128)."""
-    b = 1
-    while b < cap and S % (b * 2) == 0:
-        b *= 2
-    return b
+    from .pallas_gemm import _pow2_divisor
+    return _pow2_divisor(S, cap)
 
 
 def _fit_block(b: int, extent: int) -> int:

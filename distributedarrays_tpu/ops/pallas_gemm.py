@@ -37,6 +37,16 @@ except Exception:  # pragma: no cover
 __all__ = ["pallas_matmul"]
 
 
+def _pow2_divisor(dim: int, cap: int) -> int:
+    """Largest power-of-two divisor of ``dim`` that is <= ``cap`` — the
+    shared block-fitting primitive (also used by pallas_stencil and
+    flash_block_size)."""
+    b = 1
+    while b * 2 <= cap and dim % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 def _kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps: int,
             epilogue: Callable | None):
     @pl.when(pl.program_id(2) == 0)
@@ -108,28 +118,26 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     kb, n = b.shape
     if ka != kb:
         raise ValueError(f"matmul dim mismatch {a.shape} @ {b.shape}")
+    if interpret is None:
+        interpret = not _on_tpu()
     if block is None:
         two_byte = max(jnp.dtype(a.dtype).itemsize,
                        jnp.dtype(b.dtype).itemsize) <= 2
         bm0, bn0, bk0 = (1024, 1024, 512) if two_byte else (512, 512, 512)
 
-        # auto default: largest power-of-two divisor per dim under the
-        # tuned cap, so every shape the old fixed 256^3 default accepted
-        # keeps working — then check the result is MXU-tileable (TPU
-        # blocks need their last dim divisible by 128 and second-to-last
-        # by 8, or equal to the array dim) instead of dying in Mosaic
+        # auto default: whole dim when it fits the cap (the always-valid
+        # equal-dims escape and the old default's behavior), else the
+        # largest power-of-two divisor under the tuned cap
         def fit(dim, cap):
-            if dim <= cap:       # whole dim = the always-valid equal-dims
-                return dim       # escape (and the old default's behavior)
-            bb = 1
-            while bb * 2 <= cap and dim % (bb * 2) == 0:
-                bb *= 2
-            return bb
+            return dim if dim <= cap else _pow2_divisor(dim, cap)
 
         bm, bn, bk = fit(m, bm0), fit(n, bn0), fit(ka, bk0)
-        if not ((bm % 8 == 0 or bm == m)
-                and (bn % 128 == 0 or bn == n)
-                and (bk % 128 == 0 or bk == ka)):
+        if not interpret and not ((bm % 8 == 0 or bm == m)
+                                  and (bn % 128 == 0 or bn == n)
+                                  and (bk % 128 == 0 or bk == ka)):
+            # Mosaic blocks need their last dim divisible by 128 and
+            # second-to-last by 8 (or equal to the array dim); only real
+            # TPUs enforce this — interpret mode runs any tiling
             raise ValueError(
                 f"shapes ({m},{ka})x({kb},{n}) have no MXU-aligned "
                 "power-of-two tiling; pad the operands or pass block=")
@@ -139,8 +147,6 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     if m % bm or n % bn or ka % bk:
         raise ValueError(
             f"shapes ({m},{ka})x({kb},{n}) must divide block {(bm, bn, bk)}")
-    if interpret is None:
-        interpret = not _on_tpu()
     out_dtype = jnp.result_type(a.dtype, b.dtype)
     fn = _build(m, n, ka, bm, bn, bk, str(out_dtype), epilogue, interpret)
     return fn(a, b)

@@ -32,30 +32,22 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .pallas_gemm import _on_tpu
+from .pallas_gemm import _on_tpu, _pow2_divisor
 
 __all__ = ["stencil5_block", "supports"]
 
 _VMEM_TARGET = 2 * 1024 * 1024  # ~per-buffer VMEM budget for (bm, n) tiles
 
 
-def _pow2_divisor(m: int, cap: int) -> int:
-    """Largest power-of-two divisor of ``m`` that is <= ``cap``."""
-    b = 1
-    while b * 2 <= cap and m % (b * 2) == 0:
-        b *= 2
-    return b
-
-
 def _plan(m: int, n: int, itemsize: int, block_rows: int | None):
     """Resolve the row-block size, or None when no TPU-valid tiling
     exists.  Power-of-two blocks >= 8 satisfy the (8, 128)-or-equal block
-    rule; the one escape is a single whole-array block (== array dims)
-    small enough for VMEM."""
+    rule; the one escape is a single whole-array block (== array dims),
+    which must itself fit the VMEM budget."""
     if block_rows is None:
         block_rows = max(8, _VMEM_TARGET // (n * itemsize))
     bm = _pow2_divisor(m, min(block_rows, m))
-    if bm >= 8 or bm == m:
+    if bm >= 8:
         return bm
     if m * n * itemsize <= _VMEM_TARGET:
         return m

@@ -134,14 +134,10 @@ class SPMDContext:
         self._drop_proc_state()
 
     def _drop_proc_state(self):
-        """Release the process backend's persistent per-rank queues (set
-        lazily by spmd_process.run_spmd_process on explicit contexts)."""
-        st = getattr(self, "_proc_state", None)
-        if st is not None:
-            self._proc_state = None
-            for q in st["queues"].values():
-                q.close()
-                q.cancel_join_thread()
+        """Drop the process backend's cross-run leftover messages (set
+        lazily by spmd_process.run_spmd_process) — the process-mode
+        analog of replacing the thread mailboxes above."""
+        self._proc_state = None
 
 
 _CONTEXTS_LOCK = threading.Lock()

@@ -16,10 +16,11 @@ Design (mirrors spmd_mode's thread semantics exactly):
 - **mailboxes** are per-rank ``multiprocessing.Queue`` inboxes plus a
   rank-local stash, giving the same tagged matching with out-of-order
   buffering as the thread backend's ``_Mailbox`` (reference
-  spmd.jl:126-143).  The inboxes live on the SPMDContext and persist
-  across runs (a message sent but not received in one run is receivable
-  in the next, like the thread mailboxes); unconsumed stashed messages
-  are re-queued when a rank exits.
+  spmd.jl:126-143).  Messages sent but not received in a run persist as
+  parent-held per-rank leftover lists on the SPMDContext (receivable in
+  the next run, like the thread mailboxes) — parked in parent memory,
+  not in queue buffers, because a pipe is bounded and a parked message
+  would wedge the sender's feeder thread.
 - **failure propagation**: a shared ``multiprocessing.Event``; blocked
   receivers poll it and abort, like the thread backend's ``ctx._failed``.
 - **context storage**: each child inherits ``ctx.store`` at fork and
@@ -78,12 +79,12 @@ class _RunContext:
     pieces sendto/recvfrom/barrier/... touch (mailbox, pids, store,
     _barrier_gen, _failed)."""
 
-    def __init__(self, ctx_id, pids, queues, store, failed):
+    def __init__(self, ctx_id, pids, queues, store, failed, stash):
         self.id = ctx_id
         self.pids = list(pids)
         self.store = store
         self._queues = queues
-        self._stash: list[tuple] = []
+        self._stash: list[tuple] = stash
         self._barrier_gen = {p: 0 for p in self.pids}
         self._failed = failed
 
@@ -112,12 +113,17 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
             "backend='process' needs the fork start method (POSIX only); "
             "use the default thread backend") from None
 
-    # per-rank inboxes persist on the context across runs (thread-backend
-    # parity: a message sent in one run is receivable in the next on the
-    # same explicit context); _reset_comm/close releases them
+    # Cross-run message persistence (thread-backend parity: a message sent
+    # in one run is receivable in the next on the same explicit context)
+    # lives in PARENT memory as per-rank leftover lists, not in queue
+    # buffers: a pipe is bounded, so parking messages there deadlocks the
+    # sender's feeder thread when nobody drains.  Children inherit their
+    # leftover stash via fork; unconsumed messages ship back with the
+    # result; the parent drains reported ranks' queues for late sends.
     if ctx._proc_state is None:
-        ctx._proc_state = {"queues": {p: mpctx.Queue() for p in ctx.pids}}
-    queues = ctx._proc_state["queues"]
+        ctx._proc_state = {"leftover": {p: [] for p in ctx.pids}}
+    leftover = ctx._proc_state["leftover"]
+    queues = {p: mpctx.Queue() for p in ctx.pids}
     result_q = mpctx.Queue()
     failed = mpctx.Event()
 
@@ -125,32 +131,36 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
     from . import spmd_mode
 
     def child(rank: int):
-        rctx = _RunContext(ctx.id, ctx.pids, queues, ctx.store, failed)
+        rctx = _RunContext(ctx.id, ctx.pids, queues, ctx.store, failed,
+                           list(leftover[rank]))
         core._rank_tls.rank = rank
         spmd_mode._tls.ctxt = rctx
         try:
             try:
                 r = f(*args)
-                result_q.put((rank, "ok", r, rctx.store.get(rank, {})))
+                status = (rank, "ok", r, rctx.store.get(rank, {}))
             except BaseException as e:  # noqa: BLE001 — shipped to parent
                 failed.set()
                 # mark peer-abort secondaries structurally so the parent
                 # needn't string-match user tracebacks
                 secondary = (isinstance(e, RuntimeError)
                              and str(e) == spmd_mode._PEER_ABORT)
-                result_q.put((rank, "err", (secondary,
-                              f"{type(e).__name__}: {e}\n"
-                              f"{''.join(traceback.format_exception(e))}"),
-                              None))
+                status = (rank, "err", (secondary,
+                          f"{type(e).__name__}: {e}\n"
+                          f"{''.join(traceback.format_exception(e))}"),
+                          None)
+            # drain the inbox so unconsumed messages ride home with the
+            # result (and so peers' feeder threads blocked on this pipe
+            # get unblocked); matching ignores order, so re-stashing
+            # cannot change which message a tagged receive resolves to
+            import queue as queue_mod
+            try:
+                while True:
+                    rctx._stash.append(queues[rank].get_nowait())
+            except queue_mod.Empty:
+                pass
+            result_q.put(status + (rctx._stash,))
         finally:
-            # messages pulled into the stash but not consumed go back to
-            # this rank's inbox so they stay receivable next run (matching
-            # ignores order, so re-queueing cannot change which message a
-            # given tagged receive resolves to — only FIFO among identical
-            # (typ, from, tag) duplicates could shift, post-failure, where
-            # _reset_comm drains everything anyway)
-            for m in rctx._stash:
-                queues[rank].put(m)
             # mp.Queue.put hands off to a feeder thread; flush every queue
             # this child wrote (messages AND result) before the hard exit,
             # or buffered items silently vanish with the process
@@ -177,6 +187,31 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
     results: dict[int, Any] = {}
     stores: dict[int, dict] = {}
     errors: dict[int, str] = {}
+
+    def drain(ranks, bound_s: float = 5.0):
+        # pull late-sent messages out of exited ranks' inboxes into the
+        # parent-held leftovers — this is also what unblocks a laggard
+        # sender's feeder thread stuck on a full pipe to a dead peer.
+        # Bounded via a helper thread: get_nowait's recv can block
+        # indefinitely on a PARTIAL frame (a sender killed mid-write), and
+        # the parent must never wedge on per-run garbage.
+        ranks = [p for p in ranks if not queues[p].empty()]
+        if not ranks:       # nothing buffered: skip the helper-thread spin
+            return
+
+        def _pull():
+            for p in ranks:
+                try:
+                    while True:
+                        leftover[p].append(queues[p].get_nowait())
+                except queue_mod.Empty:
+                    pass
+
+        import threading
+        t = threading.Thread(target=_pull, daemon=True)
+        t.start()
+        t.join(bound_s)
+
     deadline = time.monotonic() + timeout
     try:
         while len(results) + len(errors) < len(ctx.pids):
@@ -187,9 +222,10 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
                     f"spmd process run did not finish in {timeout}s "
                     f"(completed ranks: {sorted(results)})")
             try:
-                rank, status, payload, store = result_q.get(
+                rank, status, payload, store, stash = result_q.get(
                     timeout=min(remaining, 0.2))
             except queue_mod.Empty:
+                drain(set(results) | set(errors))
                 dead = [p for p, pr in zip(ctx.pids, procs)
                         if not pr.is_alive() and p not in results
                         and p not in errors]
@@ -200,20 +236,31 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
                         "reporting (non-picklable result/storage, or the "
                         "child crashed)")
                 continue
+            leftover[rank] = list(stash)
             if status == "ok":
                 results[rank] = payload
                 stores[rank] = store
             else:
                 errors[rank] = payload
     finally:
+        # drain BEFORE joining: a child whose feeder is mid-write into a
+        # dead peer's full pipe can only finish (and exit) once the parent
+        # consumes that pipe; terminating it instead would truncate the
+        # frame and poison the queue
+        drain(ctx.pids)
         for pr in procs:
             pr.join(5)
+        drain(ctx.pids)          # anything flushed while joining
+        for pr in procs:
             if pr.is_alive():  # pragma: no cover — stuck child
                 pr.terminate()
-        # the message queues belong to the context (released by
-        # _reset_comm/close); only the per-run result queue dies here
-        result_q.close()
-        result_q.cancel_join_thread()
+        for q in list(queues.values()) + [result_q]:
+            q.close()
+            q.cancel_join_thread()
+        # successful ranks keep their storage writes even when a peer
+        # failed (thread backend mutates ctx.store live; mirror that)
+        for rank, st in stores.items():
+            ctx.store[rank] = st
 
     if errors:
         # prefer root-cause failures over structurally-marked peer aborts
@@ -224,6 +271,4 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
         raise RuntimeError(
             f"spmd task on rank {rank} failed ({len(errors)} total "
             f"failures); child traceback:\n{err}")
-    for rank, st in stores.items():
-        ctx.store[rank] = st
     return results

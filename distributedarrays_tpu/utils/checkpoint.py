@@ -14,12 +14,22 @@ scatter per array).  Storage is a JSON-metadata file plus either a
 self-contained ``.npz`` (default) or an Orbax PyTree store
 (``save(..., store="orbax")`` — the chunked, multi-host-capable tier);
 the layout-metadata format is shared, so both stores restore identically.
+
+``CheckpointManager`` adds the training-loop tier on top: stepped
+checkpoints under one directory, **async** saves (device→host snapshot
+happens synchronously at ``save()``; serialization and disk IO run on a
+background thread so the train loop isn't stalled), atomic publication
+(write to a hidden temp dir, rename into place), and ``max_to_keep``
+rotation of completed steps.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Any
 
@@ -29,15 +39,19 @@ import jax
 
 from ..darray import DArray, DData, distribute
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "CheckpointManager"]
 
 _META = "dartpu_meta.json"
 _ARRS = "arrays.npz"
 _ORBAX = "orbax_store"
 
 
-def _encode(tree, arrays: dict):
-    """Recursively replace array-ish leaves with tagged placeholders."""
+def _encode(tree, arrays: dict, copy: bool = False):
+    """Recursively replace array-ish leaves with tagged placeholders.
+
+    ``copy=True`` decouples plain numpy leaves from caller-owned buffers
+    (async checkpointing); device-sourced leaves (DArray/jax.Array) already
+    materialize fresh host arrays and are never re-copied."""
     if isinstance(tree, DArray):
         key = f"a{len(arrays)}"
         arrays[key] = np.asarray(tree)
@@ -47,12 +61,14 @@ def _encode(tree, arrays: dict):
                 "cuts": [list(c) for c in tree.cuts]}
     if isinstance(tree, DData):
         parts = tree.gather()
-        enc_parts = [_encode(p, arrays) for p in parts]
+        enc_parts = [_encode(p, arrays, copy) for p in parts]
         return {"__dartpu__": "DData", "parts": enc_parts,
                 "pids": [int(p) for p in tree.pids]}
     if isinstance(tree, (jax.Array, np.ndarray)):
         key = f"a{len(arrays)}"
         host = np.asarray(tree)
+        if copy and host is tree:   # numpy leaf aliasing caller memory
+            host = host.copy()
         entry = {"__dartpu__": "ndarray", "key": key,
                  "jax": isinstance(tree, jax.Array)}
         import ml_dtypes
@@ -69,14 +85,15 @@ def _encode(tree, arrays: dict):
         if all(isinstance(k, str) for k in tree) and \
                 not any(k in ("__dartpu__", "__dartpu_store__")
                         for k in tree):
-            return {k: _encode(v, arrays) for k, v in tree.items()}
+            return {k: _encode(v, arrays, copy) for k, v in tree.items()}
         # non-string keys round-trip via an item-pair encoding (plain JSON
         # would silently stringify them)
         return {"__dartpu__": "dict",
-                "items": [[_encode(k, arrays), _encode(v, arrays)]
+                "items": [[_encode(k, arrays, copy),
+                           _encode(v, arrays, copy)]
                           for k, v in tree.items()]}
     if isinstance(tree, (list, tuple)):
-        enc = [_encode(v, arrays) for v in tree]
+        enc = [_encode(v, arrays, copy) for v in tree]
         return {"__dartpu__": "tuple", "items": enc} \
             if isinstance(tree, tuple) else enc
     if isinstance(tree, bool) or tree is None or isinstance(tree, str):
@@ -151,19 +168,9 @@ def save(path: str | os.PathLike, tree: Any, store: str = "npz") -> None:
     if store not in ("npz", "orbax"):
         # validate before any side effect (no stray directories/encodes)
         raise ValueError(f"unknown store {store!r} (use 'npz' or 'orbax')")
-    path = Path(path)
-    path.mkdir(parents=True, exist_ok=True)
     arrays: dict[str, np.ndarray] = {}
     meta = _encode(tree, arrays)
-    if store == "orbax" and arrays:
-        import orbax.checkpoint as ocp
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save((path / _ORBAX).resolve(), arrays, force=True)
-    elif store == "npz":
-        np.savez(path / _ARRS, **arrays)
-    # (orbax with no array leaves: nothing to store; load mirrors this)
-    meta_doc = {"__dartpu_store__": store, "tree": meta}
-    (path / _META).write_text(json.dumps(meta_doc))
+    _write_store(Path(path), meta, arrays, store)
 
 
 def load(path: str | os.PathLike) -> Any:
@@ -189,3 +196,181 @@ def load(path: str | os.PathLike) -> Any:
         with np.load(path / _ARRS) as z:
             arrays = {k: z[k] for k in z.files}
     return _decode(meta, arrays)
+
+
+def _write_store(path: Path, meta, arrays, store: str) -> None:
+    """Serialize one already-encoded checkpoint into ``path`` (the single
+    body behind both save() and CheckpointManager publication)."""
+    path.mkdir(parents=True, exist_ok=True)
+    if store == "orbax" and arrays:
+        import orbax.checkpoint as ocp
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save((path / _ORBAX).resolve(), arrays, force=True)
+    elif store == "npz":
+        np.savez(path / _ARRS, **arrays)
+    # (orbax with no array leaves: nothing to store; load mirrors this)
+    (path / _META).write_text(
+        json.dumps({"__dartpu_store__": store, "tree": meta}))
+
+
+class CheckpointManager:
+    """Stepped checkpoints with async save and ``max_to_keep`` rotation.
+
+    The reference has no checkpoint subsystem at all (SURVEY.md §5); this
+    is the training-loop tier a TPU framework needs.  Usage::
+
+        with CheckpointManager(dir, max_to_keep=3) as mgr:
+            for step in range(...):
+                ...
+                mgr.save(step, {"params": params, "opt": opt_state})
+        state = CheckpointManager(dir).restore()        # latest step
+
+    ``save`` snapshots device state to host *synchronously* (so the train
+    loop may mutate/donate its arrays immediately) and hands
+    serialization + disk IO to one background thread; steps are written
+    to a hidden temp directory and renamed into place, so readers never
+    observe a partial checkpoint, and a crash mid-save leaves the
+    previous steps intact.  Rotation deletes the oldest completed steps
+    beyond ``max_to_keep`` after each successful save.
+    """
+
+    _STEP = "step_{:08d}"
+
+    def __init__(self, directory: str | os.PathLike,
+                 max_to_keep: int | None = 3, async_save: bool = True):
+        if max_to_keep is not None and max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._async = bool(async_save)
+        self._pool = (ThreadPoolExecutor(max_workers=1,
+                                         thread_name_prefix="ckpt")
+                      if self._async else None)
+        self._pending: dict[int, Any] = {}   # step -> in-flight future
+        self._lock = threading.Lock()
+
+    # -- inventory ---------------------------------------------------------
+
+    def steps(self) -> list[int]:
+        """Completed (published) step numbers, ascending."""
+        out = []
+        for p in self.directory.iterdir():
+            name = p.name
+            if p.is_dir() and name.startswith("step_") and \
+                    name[5:].isdigit() and (p / _META).exists():
+                out.append(int(name[5:]))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / self._STEP.format(step)
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, tree: Any, store: str = "npz") -> None:
+        """Checkpoint ``tree`` as ``step``.  Device→host transfer happens
+        before this returns; IO happens in the background (async mode)."""
+        if store not in ("npz", "orbax"):
+            raise ValueError(f"unknown store {store!r} (use 'npz'/'orbax')")
+        with self._lock:
+            self._reap(wait=False)
+            # pending/reserved steps count as existing: a duplicate racing
+            # an in-flight (or concurrently-encoding) save must get this
+            # ValueError, not a later os.replace failure from the
+            # background thread — so the step is RESERVED here, inside the
+            # same lock section as the check
+            if step in self.steps() or step in self._pending:
+                raise ValueError(f"step {step} already exists in "
+                                 f"{self.directory}")
+            self._pending[step] = None          # reservation
+        try:
+            arrays: dict[str, np.ndarray] = {}
+            # copy=True decouples plain numpy leaves from caller-owned
+            # buffers (device leaves already materialize fresh host arrays)
+            meta = _encode(tree, arrays, copy=True)
+            if self._pool is None:
+                self._publish(step, meta, arrays, store)
+                with self._lock:
+                    self._pending.pop(step, None)
+                return
+            with self._lock:
+                self._pending[step] = self._pool.submit(
+                    self._publish, step, meta, arrays, store)
+        except BaseException:
+            with self._lock:
+                self._pending.pop(step, None)
+            raise
+
+    def _publish(self, step: int, meta, arrays, store: str) -> None:
+        final = self._step_dir(step)
+        tmp = self.directory / f".tmp_{self._STEP.format(step)}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        _write_store(tmp, meta, arrays, store)
+        os.replace(tmp, final)
+        self._rotate()
+
+    def _rotate(self) -> None:
+        if self.max_to_keep is None:
+            return
+        done = self.steps()
+        for s in done[:max(0, len(done) - self.max_to_keep)]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def _reap(self, wait: bool) -> None:
+        still, first_exc = {}, None
+        for step, fut in self._pending.items():
+            if fut is None:          # reserved by a save() mid-encode
+                still[step] = fut
+            elif fut.done() or wait:
+                try:
+                    fut.result()
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    # the failed future must still leave _pending, or the
+                    # manager wedges: every later call would re-raise this
+                    # and the step could never be retried
+                    if first_exc is None:
+                        first_exc = e
+            else:
+                still[step] = fut
+        self._pending = still
+        if first_exc is not None:
+            raise first_exc
+
+    # -- restore / lifecycle ----------------------------------------------
+
+    def restore(self, step: int | None = None) -> Any:
+        """Load ``step`` (default: the latest completed one)."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no completed checkpoints in {self.directory}")
+        d = self._step_dir(step)
+        if not (d / _META).exists():
+            raise FileNotFoundError(f"no checkpoint for step {step} in "
+                                    f"{self.directory}")
+        return load(d)
+
+    def wait(self) -> None:
+        """Block until every pending async save has been published (and
+        re-raise the first background failure, if any)."""
+        with self._lock:
+            self._reap(wait=True)
+
+    def close(self) -> None:
+        self.wait()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

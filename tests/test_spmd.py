@@ -330,6 +330,54 @@ def test_process_backend_bad_backend_name():
 
 
 @process_only
+def test_process_backend_large_unconsumed_message():
+    # a ~1 MB message sent but never received must not wedge the sender's
+    # queue feeder (pipe buffers are ~64 KB) and stays receivable next run
+    ctx = S.context(pids=range(2))
+    try:
+        payload = np.arange(250_000, dtype=np.float32)
+
+        def send_big():
+            if S.myid() == 0:
+                S.sendto(1, payload, tag="big")
+            return True
+
+        def recv_big():
+            if S.myid() == 1:
+                return float(S.recvfrom(0, tag="big", timeout=10).sum())
+            return None
+
+        assert all(S.spmd(send_big, context=ctx, backend="process",
+                          timeout=60))
+        out = S.spmd(recv_big, context=ctx, backend="process", timeout=60)
+        assert out[1] == float(payload.sum())
+    finally:
+        S.close_context(ctx)
+
+
+@process_only
+def test_process_backend_storage_survives_peer_failure():
+    # successful ranks keep their context storage writes when a peer
+    # fails (the thread backend mutates storage live; process mirrors it)
+    ctx = S.context(pids=range(3))
+    try:
+        def prog():
+            me = S.myid()
+            S.context_local_storage()["v"] = me * 7
+            if me == 2:
+                raise ValueError("rank 2 exploded")
+            return True
+
+        with pytest.raises(RuntimeError, match="failed"):
+            S.spmd(prog, context=ctx, backend="process")
+        got = S.spmd(lambda: S.context_local_storage().get("v"),
+                     context=ctx, backend="process")
+        assert got[0] == 0 and got[1] == 7   # rank 2's write died with it
+    finally:
+        S.close_context(ctx)
+
+
+@process_only
 def test_process_backend_message_survives_across_runs():
     # thread-backend parity: a message sent but not received in one run
     # stays in the context's inbox for the next run

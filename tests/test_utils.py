@@ -169,3 +169,114 @@ def test_pallas_matmul_validation(rng):
         pallas_matmul(a, b, block=(64, 64, 64))
     with pytest.raises(ValueError, match="mismatch"):
         pallas_matmul(b, a)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: stepped async saves + rotation (design.md round-3
+# item 1; the reference has no checkpoint subsystem at all, SURVEY.md §5)
+# ---------------------------------------------------------------------------
+
+
+def test_ckpt_manager_save_restore_rotation(tmp_path, rng):
+    from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+    A = rng.standard_normal((24, 8)).astype(np.float32)
+    with CheckpointManager(tmp_path / "run", max_to_keep=2) as mgr:
+        for step in (1, 5, 9):
+            d = dat.distribute(A * step, procs=range(4), dist=(4, 1))
+            mgr.save(step, {"w": d, "step": step})
+            d.close()
+        mgr.wait()
+        assert mgr.steps() == [5, 9]            # step 1 rotated out
+        got = mgr.restore()                      # latest
+        assert got["step"] == 9
+        np.testing.assert_allclose(np.asarray(got["w"]), A * 9, rtol=1e-6)
+        got5 = mgr.restore(5)
+        assert got5["step"] == 5
+        got5["w"].close(); got["w"].close()
+    dat.d_closeall()
+
+
+def test_ckpt_manager_async_decouples_mutation(tmp_path):
+    # the host snapshot happens inside save(): mutating the source numpy
+    # array right after save must not corrupt the checkpoint
+    from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+    x = np.arange(16, dtype=np.float32)
+    with CheckpointManager(tmp_path / "run") as mgr:
+        mgr.save(0, {"x": x})
+        x[:] = -1.0
+    back = CheckpointManager(tmp_path / "run").restore(0)
+    np.testing.assert_array_equal(back["x"], np.arange(16, dtype=np.float32))
+
+
+def test_ckpt_manager_sync_mode_and_validation(tmp_path):
+    from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(tmp_path / "run", async_save=False,
+                            max_to_keep=None)
+    mgr.save(3, {"v": 7})
+    assert mgr.steps() == [3]
+    with pytest.raises(ValueError, match="already exists"):
+        mgr.save(3, {"v": 8})
+    with pytest.raises(ValueError, match="store"):
+        mgr.save(4, {"v": 8}, store="tape")
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(99)
+    with pytest.raises(ValueError, match="max_to_keep"):
+        CheckpointManager(tmp_path / "bad", max_to_keep=0)
+    mgr.close()
+    assert CheckpointManager(tmp_path / "run").restore()["v"] == 7
+
+
+def test_ckpt_manager_duplicate_step_pending_async(tmp_path):
+    # a duplicate step racing an in-flight async save must get the
+    # designed ValueError, not a background os.replace failure
+    from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+    with CheckpointManager(tmp_path / "run") as mgr:
+        mgr.save(5, {"x": np.zeros(4096)})
+        with pytest.raises(ValueError, match="already exists"):
+            mgr.save(5, {"x": np.ones(4096)})
+    assert CheckpointManager(tmp_path / "run").restore(5)["x"].sum() == 0
+
+
+def test_ckpt_manager_background_failure_recoverable(tmp_path, monkeypatch):
+    # a failed background save surfaces once and the step can be retried —
+    # the failed future must leave the pending set, not wedge the manager
+    from distributedarrays_tpu.utils import checkpoint as ck
+    real = ck._write_store
+    boom = {"n": 0}
+
+    def flaky(*a, **k):
+        if boom["n"] == 0:
+            boom["n"] += 1
+            raise OSError("disk full (simulated)")
+        return real(*a, **k)
+
+    monkeypatch.setattr(ck, "_write_store", flaky)
+    mgr = ck.CheckpointManager(tmp_path / "run")
+    mgr.save(1, {"v": 1})
+    with pytest.raises(OSError, match="disk full"):
+        mgr.wait()
+    mgr.save(1, {"v": 2})        # retry after failure must be allowed
+    mgr.wait()
+    assert mgr.restore(1)["v"] == 2
+    mgr.close()
+
+
+def test_ckpt_manager_orbax_tier(tmp_path, rng):
+    from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+    A = rng.standard_normal((8, 8)).astype(np.float32)
+    with CheckpointManager(tmp_path / "run") as mgr:
+        mgr.save(2, {"a": A}, store="orbax")
+    back = CheckpointManager(tmp_path / "run").restore(2)
+    np.testing.assert_allclose(back["a"], A, rtol=1e-6)
+
+
+def test_ckpt_manager_ignores_partial_tmp_dirs(tmp_path):
+    # a crash mid-save leaves only the hidden temp dir; steps() and
+    # restore() must not see it
+    from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+    mgr = CheckpointManager(tmp_path / "run", async_save=False)
+    mgr.save(1, {"v": 1})
+    (tmp_path / "run" / ".tmp_step_00000007").mkdir()
+    (tmp_path / "run" / "step_00000009").mkdir()   # no meta -> incomplete
+    assert mgr.steps() == [1]
+    assert mgr.restore()["v"] == 1
