@@ -34,32 +34,39 @@ from jax.experimental import pallas as pl
 
 from .pallas_gemm import _on_tpu, _pow2_divisor
 
-__all__ = ["stencil5_block", "supports"]
+__all__ = ["stencil5_block", "stencil5_multistep", "supports"]
 
 _VMEM_TARGET = 2 * 1024 * 1024  # ~per-buffer VMEM budget for (bm, n) tiles
 
 
-def _plan(m: int, n: int, itemsize: int, block_rows: int | None):
+def _plan(m: int, n: int, itemsize: int, block_rows: int | None,
+          k: int = 0):
     """Resolve the row-block size, or None when no TPU-valid tiling
     exists.  Power-of-two blocks >= 8 satisfy the (8, 128)-or-equal block
     rule; the one escape is a single whole-array block (== array dims),
-    which must itself fit the VMEM budget."""
+    which must itself fit the VMEM budget.  ``k`` > 0 budgets for the
+    temporal kernel's (bm + 2k, n) ghost-extended buffers."""
     if block_rows is None:
-        block_rows = max(8, _VMEM_TARGET // (n * itemsize))
+        block_rows = max(8, _VMEM_TARGET // (n * itemsize) - 2 * k)
     bm = _pow2_divisor(m, min(block_rows, m))
     if bm >= 8:
+        # the floor of 8 rows can still blow the budget once the 2k ghost
+        # rows are added (wide n, deep k) — refuse rather than overshoot
+        if k and (bm + 2 * k) * n * itemsize > _VMEM_TARGET:
+            return None
         return bm
-    if m * n * itemsize <= _VMEM_TARGET:
+    if (m + 2 * k) * n * itemsize <= _VMEM_TARGET:
         return m
     return None
 
 
-def supports(m: int, n: int, dtype) -> bool:
-    """Whether ``stencil5_block`` can tile an (m, n) block on TPU — the
-    single source of truth for routers choosing between this kernel and
-    the jnp formulation (models/stencil.py)."""
+def supports(m: int, n: int, dtype, k: int = 0) -> bool:
+    """Whether ``stencil5_block`` (``k`` = 0) / ``stencil5_multistep``
+    (``k`` = temporal depth) can tile an (m, n) block on TPU — the single
+    source of truth for routers choosing between these kernels and the
+    jnp formulation (models/stencil.py)."""
     import jax.numpy as jnp
-    return _plan(m, n, jnp.dtype(dtype).itemsize, None) is not None
+    return _plan(m, n, jnp.dtype(dtype).itemsize, None, k) is not None
 
 
 def _kernel(mid_ref, top_ref, bot_ref, o_ref):
@@ -131,3 +138,102 @@ def stencil5_block(block, lo, hi, block_rows: int | None = None,
         top_rows, bot_rows = lo, hi
     return _build(m, n, bm, str(block.dtype), bool(interpret))(
         block, top_rows[:, None, :], bot_rows[:, None, :])
+
+
+# ---------------------------------------------------------------------------
+# Temporal blocking: k Laplacian steps per launch (trapezoid / ghost-zone
+# scheme).  One launch reads the grid ~(1 + 2k/bm) times and writes it once,
+# so HBM traffic per step drops to ~(2 + 2k/bm)/k passes instead of 2 —
+# the only way past the single-step read+write roofline the streaming
+# kernel above already sits on.
+#
+# Correctness: each block's buffer carries k ghost rows on both sides,
+# seeded with step-0 values of the neighboring block (or the k-deep rank
+# halo from ``halo_exchange(halo=k)``).  Stencil steps corrupt the ghost
+# zone inward one row per step (its outermost rows lack neighbors), so
+# after k steps exactly the middle ``bm`` rows are correct — the classic
+# trapezoid argument.  The one case ghost evolution cannot express is the
+# global Dirichlet edge (the zero boundary is zero at EVERY step, not just
+# step 0); the kernel re-zeroes the ghost zone of the first/last block
+# after each step when the rank-level edge flags say this rank sits on the
+# global boundary.
+# ---------------------------------------------------------------------------
+
+
+def _kernel_multi(buf_ref, topf_ref, botf_ref, o_ref, *, k, bm, m):
+    x = buf_ref[0]                                      # (bm + 2k, n)
+    i = pl.program_id(0)
+    top_d = topf_ref[0, 0] != 0
+    bot_d = botf_ref[0, 0] != 0
+    # outside-domain rows in GLOBAL extended coordinates (buffer row r is
+    # extended row i*bm + r; rows < k / >= m + k lie beyond the domain) —
+    # block-local gating would miss ghost rows spilling into the second /
+    # penultimate block's window when k >= bm + 2
+    rows = i * bm + jax.lax.broadcasted_iota(jnp.int32, (bm + 2 * k, 1), 0)
+    ghost = ((rows < k) & top_d) | ((rows >= m + k) & bot_d)
+    keep = jnp.where(ghost, 0, 1).astype(x.dtype)       # (bm + 2k, 1)
+    for _ in range(k):
+        zr = jnp.zeros_like(x[:1])
+        up = jnp.concatenate([zr, x[:-1]], axis=0)
+        down = jnp.concatenate([x[1:], zr], axis=0)
+        zc = jnp.zeros_like(x[:, :1])
+        left = jnp.concatenate([zc, x[:, :-1]], axis=1)
+        right = jnp.concatenate([x[:, 1:], zc], axis=1)
+        x = (up + down + left + right - 4.0 * x) * keep
+    o_ref[...] = x[k:k + bm]
+
+
+@functools.lru_cache(maxsize=64)
+def _build_multi(m, n, bm, k, dtype_str, interpret):
+    nb = m // bm
+    return pl.pallas_call(
+        functools.partial(_kernel_multi, k=k, bm=bm, m=m),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, bm + 2 * k, n), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),     # top Dirichlet flag
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),     # bottom flag
+        ],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.dtype(dtype_str)),
+        interpret=interpret,
+    )
+
+
+def stencil5_multistep(block, lo, hi, k: int, top_dirichlet, bot_dirichlet,
+                       block_rows: int | None = None,
+                       interpret: bool | None = None):
+    """``k`` 5-point Laplacian steps on a local (m, n) block in ONE kernel
+    launch (temporal blocking — see the scheme note above).
+
+    ``lo``/``hi``: the (k, n) step-0 halo slabs from the neighboring ranks
+    (``halo_exchange(..., halo=k)``; zeros at the global edge).
+    ``top_dirichlet``/``bot_dirichlet``: scalars (python or traced bools),
+    true when this rank's top/bottom edge is the global zero boundary —
+    inside ``shard_map`` pass ``axis_index == 0`` / ``== nranks - 1``.
+    Semantics match ``k`` applications of models/stencil.py's jnp step.
+    """
+    m, n = block.shape
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"k must be >= 1; got {k}")
+    if lo.shape != (k, n) or hi.shape != (k, n):
+        raise ValueError(f"halo slabs must be ({k}, {n}); got {lo.shape}, "
+                         f"{hi.shape}")
+    bm = _plan(m, n, block.dtype.itemsize, block_rows, k)
+    if bm is None:
+        raise ValueError(
+            f"stencil5_multistep has no TPU-valid tiling for ({m}, {n}) "
+            f"{block.dtype} at k={k}; use the jnp path (use_pallas=False) "
+            "for this layout")
+    if interpret is None:
+        interpret = not _on_tpu()
+    nb = m // bm
+    extended = jnp.concatenate([lo, block, hi], axis=0)  # (m + 2k, n)
+    # per-block ghost-extended buffers: overlapping (bm + 2k)-row windows at
+    # stride bm — a full-row gather, (1 + 2k/bm)x input traffic
+    row_idx = (jnp.arange(nb) * bm)[:, None] + jnp.arange(bm + 2 * k)[None, :]
+    buf = jnp.take(extended, row_idx, axis=0)            # (nb, bm+2k, n)
+    flag = lambda v: jnp.asarray(v).reshape(1, 1).astype(block.dtype)
+    return _build_multi(m, n, bm, k, str(block.dtype), bool(interpret))(
+        buf, flag(top_dirichlet), flag(bot_dirichlet))

@@ -83,6 +83,72 @@ def test_stencil5_pallas_odd_rows(rng):
         stencil5_block(big, zb, zb)
 
 
+def test_stencil5_temporal_matches_oracle(rng):
+    # temporal blocking (k steps per launch, depth-k ghost zones) must be
+    # bit-exact vs iterating the jnp step: k dividing iters, a remainder
+    # launch, k > iters clamped, and the auto depth
+    A = rng.standard_normal((64, 32)).astype(np.float32)
+    want = A
+    for _ in range(5):
+        want = _lap(want)
+    for kt in (2, 3, 5, None):
+        d = dat.distribute(A, procs=range(8), dist=(8, 1))
+        got = np.asarray(stencil.stencil5(d, iters=5, use_pallas=True,
+                                          temporal=kt))
+        assert np.allclose(got, want, rtol=1e-4, atol=1e-4), kt
+
+
+def test_stencil5_temporal_single_rank_dirichlet(rng):
+    # one rank owns both global edges: the in-kernel per-step re-zero of
+    # the Dirichlet ghost zones is what keeps this exact
+    A = rng.standard_normal((32, 32)).astype(np.float32)
+    want = A
+    for _ in range(7):
+        want = _lap(want)
+    d = dat.distribute(A, procs=[0], dist=(1, 1))
+    got = np.asarray(stencil.stencil5(d, iters=7, use_pallas=True,
+                                      temporal=4))
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_stencil5_temporal_ghost_deeper_than_block(rng):
+    # k >= bm + 2: the Dirichlet ghost zone spills past the first/last
+    # row-block, so the in-kernel re-zero must use global row coordinates
+    # (block-local gating corrupts rows near the global edge)
+    from distributedarrays_tpu.ops.pallas_stencil import stencil5_multistep
+    A = rng.standard_normal((32, 128)).astype(np.float32)
+    k = 12
+    want = A
+    for _ in range(k):
+        want = _lap(want)
+    z = jnp.zeros((k, 128), jnp.float32)
+    got = np.asarray(stencil5_multistep(jnp.asarray(A), z, z, k,
+                                        True, True, block_rows=8))
+    assert np.allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_stencil5_multistep_vmem_refusal():
+    # the 8-row block floor must not overshoot the VMEM budget once ghost
+    # rows are added: _plan refuses and supports() reports it
+    from distributedarrays_tpu.ops.pallas_stencil import supports
+    assert supports(8192, 8192, np.float32)            # single-step fine
+    assert not supports(1024, 65536, np.float32, k=8)  # 6 MiB buffers
+    assert supports(1024, 65536, np.float32, k=0)      # streaming still ok
+
+
+def test_stencil5_multistep_validation(rng):
+    from distributedarrays_tpu.ops.pallas_stencil import stencil5_multistep
+    A = jnp.zeros((16, 32), jnp.float32)
+    z = jnp.zeros((2, 32), jnp.float32)
+    with pytest.raises(ValueError, match="halo slabs"):
+        stencil5_multistep(A, z[:1], z, 2, True, True)
+    with pytest.raises(ValueError, match="k must be"):
+        stencil5_multistep(A, z, z, 0, True, True)
+    d = dat.dzeros((64, 32), procs=range(8), dist=(8, 1))
+    with pytest.raises(ValueError, match="temporal"):
+        stencil.stencil5(d, iters=64, use_pallas=True, temporal=32)
+
+
 def test_pallas_matmul_auto_block_fits():
     # the auto default must keep accepting shapes the old 256^3 default
     # took (e.g. 1536: divisible by 256, not by 1024/512-tile clipping)
