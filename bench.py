@@ -52,10 +52,12 @@ def _marginal(run_for_length, L0=10, min_delta=0.05, max_L=1000):
         L *= 4
 
 
-def _run_with_timeout(fn, timeout_s: float):
+def _run_with_timeout(fn, timeout_s: float, grace_s: float = 0.0):
     """Run ``fn`` on a daemon thread with a hard timeout (a wedged remote
-    tunnel hangs forever instead of erroring).  Returns
-    ``(finished, value_or_exception)``; on timeout the thread is abandoned."""
+    tunnel hangs forever instead of erroring).  Returns ``(finished,
+    value_or_exception, thread)``; on timeout the thread is abandoned
+    after an optional ``grace_s`` extra join (callers can use the thread
+    handle to detect an orphan still dispatching device work)."""
     import threading
 
     box = {}
@@ -69,11 +71,13 @@ def _run_with_timeout(fn, timeout_s: float):
     t = threading.Thread(target=runner, daemon=True)
     t.start()
     t.join(timeout_s)
+    if t.is_alive() and grace_s:
+        t.join(grace_s)
     if t.is_alive():
-        return False, None
+        return False, None, t
     if "error" in box:
-        return True, box["error"]
-    return True, box.get("value")
+        return True, box["error"], t
+    return True, box.get("value"), t
 
 
 def _device_watchdog(timeout_s: float = 480.0):
@@ -82,7 +86,7 @@ def _device_watchdog(timeout_s: float = 480.0):
         import jax.numpy as jnp
         return float(jnp.sum(jnp.ones((8, 8))))
 
-    finished, v = _run_with_timeout(probe, timeout_s)
+    finished, v, _ = _run_with_timeout(probe, timeout_s)
     if not finished:
         return {"ok": False, "error": f"device probe timed out after "
                                       f"{timeout_s:.0f}s (wedged tunnel?)"}
@@ -97,6 +101,48 @@ def _device_watchdog(timeout_s: float = 480.0):
 def _save(details):
     Path(__file__).with_name("BENCH_DETAILS.json").write_text(
         json.dumps(details, indent=2))
+
+
+_START = time.monotonic()
+_GLOBAL_BUDGET_S = 2400.0   # leave headroom under the driver's own timeout
+
+
+def _guarded(details, label, fn, timeout_s=420.0):
+    """Run one optional bench config on a daemon thread with a timeout and
+    a global deadline: a wedged tunnel (observed: remote_compile dying
+    mid-read, then every subsequent dispatch hanging) must cost at most
+    one config's budget, and never the already-banked numbers or the
+    headline.  ``fn`` returns a dict merged into ``details``."""
+    def _remaining():
+        return _GLOBAL_BUDGET_S - (time.monotonic() - _START)
+
+    if _remaining() < 60:
+        details[f"{label}_error"] = "skipped (global bench deadline)"
+        _save(details)
+        return
+    effective = min(timeout_s, _remaining())
+    finished, res, thread = _run_with_timeout(fn, effective)
+    if finished and isinstance(res, Exception) and \
+            "remote_compile" in str(res) and _remaining() > 75:
+        # transient tunnel-service flake (observed: response body closed
+        # mid-read); one retry after a settle pause, against the budget
+        # actually left now
+        time.sleep(15)
+        effective = min(timeout_s, _remaining())
+        finished, res, thread = _run_with_timeout(fn, effective)
+    if not finished:
+        details[f"{label}_error"] = f"timed out after {effective:.0f}s"
+        # the abandoned thread may still be dispatching device work; give
+        # it a bounded drain so it cannot pollute the NEXT config's
+        # timings, and flag it if it outlives the grace
+        thread.join(60)
+        if thread.is_alive():
+            details[f"{label}_orphan_running"] = True
+    elif isinstance(res, Exception):
+        details[f"{label}_error"] = f"{type(res).__name__}: {res}"
+    elif res:
+        details.update(res)
+    _save(details)
 
 
 def main():
@@ -164,6 +210,16 @@ def main():
     details["cpu_numpy_gflops"] = cpu_gflops
     _save(details)
 
+    # headline out NOW: everything after this point is banked detail, and a
+    # tunnel wedge in a later config must not cost the round its one JSON
+    # line (round-1 lesson; this run prints exactly this one line)
+    print(json.dumps({
+        "metric": "gemm_4096_gflops_mixed_precision_bf16pass",
+        "value": round(gflops, 2),
+        "unit": "GFLOPS",
+        "vs_baseline": round(gflops / cpu_gflops, 2),
+    }), flush=True)
+
     # ---- config 1: broadcast chain sin.(A) .+ B .* C on 8192^2 ----------
     M = 8192
     X = dat.drand((M, M)); Y = dat.drand((M, M)); Z = dat.drand((M, M))
@@ -178,10 +234,12 @@ def main():
         float(f(X, Y, Z))
         return min(_t(lambda: float(f(X, Y, Z))) for _ in range(3))
 
-    t_chain = _marginal(chain_chain, L0=20)
-    details["broadcast_chain_8192_marginal_s"] = t_chain
-    details["broadcast_chain_8192_gbps"] = 4 * M * M * 4 / t_chain / 1e9
-    _save(details)
+    def cfg_chain():
+        t_chain = _marginal(chain_chain, L0=20)
+        return {"broadcast_chain_8192_marginal_s": t_chain,
+                "broadcast_chain_8192_gbps": 4 * M * M * 4 / t_chain / 1e9}
+
+    _guarded(details, "broadcast_chain", cfg_chain)
 
     # ---- config 2: mapreduce(abs2,+) and mean/std over 1e8 --------------
     V = dat.drand((100_000_000,))
@@ -197,41 +255,55 @@ def main():
         float(f(V))
         return min(_t(lambda: float(f(V))) for _ in range(3))
 
-    t_mr = _marginal(mr_chain, L0=40)
-    details["mapreduce_1e8_marginal_s"] = t_mr
-    details["mapreduce_1e8_gbps"] = 4 * 1e8 / t_mr / 1e9
-    float(dat.dmean(V)); float(dat.dstd(V))
-    details["mean_std_1e8_eager_s"] = _t(
-        lambda: (float(dat.dmean(V)), float(dat.dstd(V))))
-    _save(details)
+    def cfg_mr():
+        t_mr = _marginal(mr_chain, L0=40)
+        out = {"mapreduce_1e8_marginal_s": t_mr,
+               "mapreduce_1e8_gbps": 4 * 1e8 / t_mr / 1e9}
+        float(dat.dmean(V)); float(dat.dstd(V))
+        out["mean_std_1e8_eager_s"] = _t(
+            lambda: (float(dat.dmean(V)), float(dat.dstd(V))))
+        return out
+
+    _guarded(details, "mapreduce", cfg_mr)
 
     # ---- config 4: stencil halo exchange on 8192^2 -----------------------
     rows = (M // ndev) * ndev
     S = dat.drand((rows, M), procs=range(ndev), dist=(ndev, 1))
 
-    def st(iters, use_pallas=None):
-        r = stencil.stencil5(S, iters=iters, use_pallas=use_pallas)
+    def st(iters, use_pallas=None, temporal=None):
+        r = stencil.stencil5(S, iters=iters, use_pallas=use_pallas,
+                             temporal=temporal)
         v = float(dat.dsum(r))                       # one compiled scan
         r.close()
         return v
 
-    def st_len_at(use_pallas):
+    def st_len_at(use_pallas, temporal=None):
         def st_len(L):
-            st(L, use_pallas)                        # compile
-            return min(_t(lambda: st(L, use_pallas)) for _ in range(2))
+            st(L, use_pallas, temporal)              # compile
+            return min(_t(lambda: st(L, use_pallas, temporal))
+                       for _ in range(2))
         return st_len
 
-    # default path (the Pallas streaming kernel on TPU: 39.7 vs 13.9
-    # Gcell/s measured on v5e), plus the jnp formulation for comparison
-    t_st = _marginal(st_len_at(None), L0=10)
-    details["stencil_8192_step_marginal_s"] = t_st
-    details["stencil_8192_gcells_per_s"] = rows * M / t_st / 1e9
-    try:
+    # single-step streaming kernel (the BASELINE config semantics: one
+    # halo exchange per step), the jnp formulation for comparison, and the
+    # temporal-blocked kernel (k=8 steps per launch, ghost-zone scheme)
+    def cfg_stencil():
+        t_st = _marginal(st_len_at(None, temporal=1), L0=10)
+        return {"stencil_8192_step_marginal_s": t_st,
+                "stencil_8192_gcells_per_s": rows * M / t_st / 1e9}
+
+    def cfg_stencil_jnp():
         t_stj = _marginal(st_len_at(False), L0=10)
-        details["stencil_8192_jnp_gcells_per_s"] = rows * M / t_stj / 1e9
-    except Exception as e:  # pragma: no cover
-        details["stencil_jnp_error"] = f"{type(e).__name__}: {e}"
-    _save(details)
+        return {"stencil_8192_jnp_gcells_per_s": rows * M / t_stj / 1e9}
+
+    def cfg_stencil_temporal():
+        t_stt = _marginal(st_len_at(None), L0=16)    # auto temporal depth
+        return {"stencil_8192_temporal_marginal_s": t_stt,
+                "stencil_8192_temporal_gcells_per_s": rows * M / t_stt / 1e9}
+
+    _guarded(details, "stencil", cfg_stencil)
+    _guarded(details, "stencil_jnp", cfg_stencil_jnp)
+    _guarded(details, "stencil_temporal", cfg_stencil_temporal)
 
     # free the bandwidth-config buffers before the 16k arrays go up
     for arr in (X, Y, Z, V, S):
@@ -265,17 +337,16 @@ def main():
             return min(_t(lambda: float(f(A3, B3))) for _ in range(2))
         return gemm16_chain
 
-    try:
+    def cfg_gemm16():
         t16 = _marginal(gemm16_chain_at(jax.lax.Precision.DEFAULT),
                         L0=5, min_delta=0.1)
-        details[f"{tag}_bf16pass_marginal_s"] = t16
-        details[f"{tag}_bf16pass_gflops"] = 2 * K16**3 / t16 / 1e9
-    except Exception as e:  # pragma: no cover
-        details[f"{tag}_error"] = f"{type(e).__name__}: {e}"
-    _save(details)
+        return {f"{tag}_bf16pass_marginal_s": t16,
+                f"{tag}_bf16pass_gflops": 2 * K16**3 / t16 / 1e9}
+
+    _guarded(details, tag, cfg_gemm16, timeout_s=600)
 
     # ---- extra: Pallas flash attention at long context -------------------
-    try:
+    def cfg_flash():
         from distributedarrays_tpu.ops.pallas_attention import flash_attention
         SQ, HQ, DQ = 8192, 8, 64
         q = jax.random.normal(jax.random.key(1), (SQ, HQ, DQ), jnp.bfloat16)
@@ -296,17 +367,16 @@ def main():
         t_fa = _marginal(fa_len, L0=4, min_delta=0.05)
         # causal flash: ~2*S^2*D*H flops (QK^T + PV), halved by causality
         flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
-        details["flash_attn_8k_bf16_marginal_s"] = t_fa
-        details["flash_attn_8k_bf16_tflops"] = flops / t_fa / 1e12
-    except Exception as e:  # pragma: no cover
-        details["flash_attn_error"] = f"{type(e).__name__}: {e}"
-    _save(details)
+        return {"flash_attn_8k_bf16_marginal_s": t_fa,
+                "flash_attn_8k_bf16_tflops": flops / t_fa / 1e12}
+
+    _guarded(details, "flash_attn", cfg_flash)
 
     # ---- extra: fused (Pallas) vs einsum ring-attention hop --------------
     # One chip = a 1-rank ring, so this isolates the per-hop compute the
     # ring pipelines against ppermute: the fused path must be >= the
     # einsum composition (VERDICT round-2 item 7).
-    try:
+    def cfg_ring():
         from distributedarrays_tpu import layout as L
         from distributedarrays_tpu.models.ring_attention import (
             ring_attention_kernel, ring_flash_attention_kernel)
@@ -338,15 +408,14 @@ def main():
                             L0=4, min_delta=0.05)
         t_einsum = _marginal(ring_len(ring_attention_kernel),
                              L0=4, min_delta=0.05)
-        details["ring_hop_fused_8k_bf16_s"] = t_fused
-        details["ring_hop_einsum_8k_bf16_s"] = t_einsum
-        details["ring_hop_fused_speedup"] = t_einsum / t_fused
-    except Exception as e:  # pragma: no cover
-        details["ring_hop_error"] = f"{type(e).__name__}: {e}"
-    _save(details)
+        return {"ring_hop_fused_8k_bf16_s": t_fused,
+                "ring_hop_einsum_8k_bf16_s": t_einsum,
+                "ring_hop_fused_speedup": t_einsum / t_fused}
+
+    _guarded(details, "ring_hop", cfg_ring)
 
     # ---- extra: hand-written Pallas GEMM kernel (compiled) ---------------
-    try:
+    def cfg_pallas_gemm():
         from distributedarrays_tpu.ops.pallas_gemm import pallas_matmul
         ap = jax.random.normal(jax.random.key(3), (4096, 4096), jnp.bfloat16)
         bp = jax.random.normal(jax.random.key(4), (4096, 4096), jnp.bfloat16)
@@ -363,14 +432,13 @@ def main():
             return min(_t(lambda: float(jf())) for _ in range(2))
 
         t_pg = _marginal(pg_len, L0=4, min_delta=0.05)
-        details["pallas_gemm_4096_bf16_marginal_s"] = t_pg
-        details["pallas_gemm_4096_bf16_tflops"] = 2 * 4096**3 / t_pg / 1e12
-    except Exception as e:  # pragma: no cover
-        details["pallas_gemm_error"] = f"{type(e).__name__}: {e}"
-    _save(details)
+        return {"pallas_gemm_4096_bf16_marginal_s": t_pg,
+                "pallas_gemm_4096_bf16_tflops": 2 * 4096**3 / t_pg / 1e12}
+
+    _guarded(details, "pallas_gemm", cfg_pallas_gemm)
 
     # ---- extra: distributed sort over 1e7 elements -----------------------
-    try:
+    def cfg_sort():
         from distributedarrays_tpu.ops.sort import dsort
         VS = dat.drand((10_000_000,))
 
@@ -383,11 +451,11 @@ def main():
 
         sort_once()                       # compile
         t_sort = min(_t(sort_once) for _ in range(2))
-        details["sort_1e7_s"] = t_sort
-        details["sort_1e7_melem_per_s"] = 1e7 / t_sort / 1e6
-    except Exception as e:  # pragma: no cover
-        details["sort_error"] = f"{type(e).__name__}: {e}"
-    _save(details)
+        VS.close()
+        return {"sort_1e7_s": t_sort,
+                "sort_1e7_melem_per_s": 1e7 / t_sort / 1e6}
+
+    _guarded(details, "sort", cfg_sort)
 
     # ---- last (riskiest): true-f32 GEMM (precision=HIGHEST) --------------
     # attempted after everything is banked, under a thread timeout: a
@@ -396,48 +464,22 @@ def main():
     # late completion cannot mutate `details` mid-serialization), and the
     # headline is printed BEFORE touching the device again.
     def highest():
-        out = {}
         t = _marginal(gemm_chain_at(jax.lax.Precision.HIGHEST), L0=50)
-        out["gemm_4096_f32_highest_marginal_s"] = t
-        out["gemm_4096_f32_highest_gflops"] = 2 * N**3 / t / 1e9
-        return out
+        return {"gemm_4096_f32_highest_marginal_s": t,
+                "gemm_4096_f32_highest_gflops": 2 * N**3 / t / 1e9}
 
-    finished, res = _run_with_timeout(highest, 600)
-    if not finished:
-        details["gemm_f32_highest_error"] = "timed out (remote compile hang)"
-    elif isinstance(res, Exception):
-        details["gemm_f32_highest_error"] = f"{type(res).__name__}: {res}"
-    else:
-        details.update(res)
-    _save(dict(details))
+    _guarded(details, "gemm_f32_highest", highest, timeout_s=600)
 
     # the 16k f32-HIGHEST pass (the BASELINE config-3 metric), same guard
     def highest16():
-        out = {}
         t = _marginal(gemm16_chain_at(jax.lax.Precision.HIGHEST),
                       L0=3, min_delta=0.2)
-        out[f"{tag}_f32_highest_marginal_s"] = t
-        out[f"{tag}_f32_highest_gflops"] = 2 * K16**3 / t / 1e9
-        return out
+        return {f"{tag}_f32_highest_marginal_s": t,
+                f"{tag}_f32_highest_gflops": 2 * K16**3 / t / 1e9}
 
-    finished, res = _run_with_timeout(highest16, 600)
-    if not finished:
-        details[f"{tag}_f32_highest_error"] = "timed out (remote compile hang)"
-    elif isinstance(res, Exception):
-        details[f"{tag}_f32_highest_error"] = f"{type(res).__name__}: {res}"
-    else:
-        details.update(res)
+    _guarded(details, f"{tag}_f32_highest", highest16, timeout_s=600)
 
-    _save(dict(details))
-
-    print(json.dumps({
-        "metric": "gemm_4096_gflops_mixed_precision_bf16pass",
-        "value": round(gflops, 2),
-        "unit": "GFLOPS",
-        "vs_baseline": round(gflops / cpu_gflops, 2),
-    }), flush=True)
-
-    # cleanup may hang on a wedged tunnel: bounded, after the metric is out
+    # cleanup may hang on a wedged tunnel: bounded (headline already out)
     _run_with_timeout(dat.d_closeall, 60)
 
 

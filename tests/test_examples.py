@@ -11,7 +11,8 @@ import pytest
 EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
 
 # fast examples only; the training demos are exercised by their own suites
-FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py"]
+FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py",
+        "cg_poisson.py"]
 
 
 @pytest.mark.parametrize("script", FAST)
@@ -21,3 +22,7 @@ def test_example_runs(script):
                        capture_output=True, text=True, timeout=420, env=env)
     assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
     assert r.stdout.strip(), f"{script} produced no output"
+    if script == "cg_poisson.py":
+        # a convergence regression in the stencil/BLAS-1 stack must fail
+        # loudly, not just print a different message
+        assert "CG converged in" in r.stdout, r.stdout
