@@ -29,7 +29,8 @@ from .. import layout as L
 from ..darray import DArray, _wrap_global, distribute
 from ..parallel.collectives import halo_exchange, halo_exchange_2d
 
-__all__ = ["stencil5_step", "stencil5", "life_step", "life", "life2d"]
+__all__ = ["stencil5_step", "stencil5", "stencil3x3", "life_step", "life",
+           "life2d"]
 
 
 def _row_mesh(d: DArray):
@@ -43,41 +44,39 @@ def _row_mesh(d: DArray):
     return L.mesh_for(pids, (n, 1)), pids
 
 
-def _stencil_kernel(axis: str, use_pallas: bool):
+def _stencil_kernel(axis: str, use_pallas: bool, weights):
     def step(block):
         lo, hi = halo_exchange(block, axis, halo=1, dim=0, wrap=False)
         if use_pallas:
             # single-pass VMEM-streaming kernel (ops/pallas_stencil.py):
             # approaches the read+write bandwidth roofline where the jnp
             # formulation below costs several HBM round-trips
-            from ..ops.pallas_stencil import stencil5_block
-            return stencil5_block(block, lo, hi)
-        x = jnp.concatenate([lo, block, hi], axis=0)
-        up, down = x[:-2, :], x[2:, :]
-        left = jnp.concatenate([jnp.zeros_like(block[:, :1]), block[:, :-1]],
-                               axis=1)
-        right = jnp.concatenate([block[:, 1:], jnp.zeros_like(block[:, :1])],
-                                axis=1)
-        return up + down + left + right - 4.0 * block
+            from ..ops.pallas_stencil import stencil3x3_block
+            return stencil3x3_block(block, lo, hi, weights)
+        from ..ops.pallas_stencil import _apply3x3
+        ext = jnp.concatenate([lo, block, hi], axis=0)
+        return _apply3x3(ext, weights)
     return step
 
 
-def _stencil_multistep(axis: str, k: int):
+def _stencil_multistep(axis: str, k: int, weights):
     """k steps per launch: k-deep halo + the temporal-blocked kernel."""
-    from ..ops.pallas_stencil import stencil5_multistep
+    from ..ops.pallas_stencil import stencil3x3_multistep
 
     def steps(block):
         lo, hi = halo_exchange(block, axis, halo=k, dim=0, wrap=False)
         r = lax.axis_index(axis)
         nr = lax.axis_size(axis)
-        return stencil5_multistep(block, lo, hi, k, r == 0, r == nr - 1)
+        return stencil3x3_multistep(block, lo, hi, k, r == 0, r == nr - 1,
+                                    weights)
     return steps
 
 
 @functools.lru_cache(maxsize=32)
-def _stencil_jit(mesh, iters: int, use_pallas: bool, temporal: int = 1):
+def _stencil_jit(mesh, iters: int, use_pallas: bool, temporal: int,
+                 weights):
     axis = mesh.axis_names[0]
-    step = _stencil_kernel(axis, use_pallas)
+    step = _stencil_kernel(axis, use_pallas, weights)
 
     def many(block):
         if temporal > 1:
@@ -86,7 +85,7 @@ def _stencil_jit(mesh, iters: int, use_pallas: bool, temporal: int = 1):
             # multistep path's gather buys nothing at k=1)
             nfull, rem = divmod(iters, temporal)
             if nfull:
-                stepk = _stencil_multistep(axis, temporal)
+                stepk = _stencil_multistep(axis, temporal, weights)
 
                 def body(b, _):
                     return stepk(b), None
@@ -94,7 +93,7 @@ def _stencil_jit(mesh, iters: int, use_pallas: bool, temporal: int = 1):
             if rem == 1:
                 block = step(block)
             elif rem:
-                block = _stencil_multistep(axis, rem)(block)
+                block = _stencil_multistep(axis, rem, weights)(block)
             return block
 
         def body(b, _):
@@ -113,11 +112,15 @@ def stencil5_step(d: DArray) -> DArray:
     return stencil5(d, iters=1)
 
 
-def stencil5(d: DArray, iters: int = 1,
-             use_pallas: bool | None = None,
-             temporal: int | None = None) -> DArray:
-    """``iters`` Laplacian steps compiled as one program (lax.scan over the
-    halo-exchange step; communication = 2 ppermutes/step over ICI).
+def stencil3x3(d: DArray, weights, iters: int = 1,
+               use_pallas: bool | None = None,
+               temporal: int | None = None) -> DArray:
+    """``iters`` weighted 3x3 stencil steps compiled as one program
+    (lax.scan over the halo-exchange step; communication = 2
+    ppermutes/step over ICI): ``out[i,j] = sum_ab w[a][b]*x[i-1+a,j-1+b]``
+    with zero boundary.  Diffusion steps, blurs, sharpen filters and the
+    5-point Laplacian (``stencil5``) are all instances; weights compile
+    into the kernel, so zero taps cost nothing.
 
     ``use_pallas`` defaults to auto: the Pallas streaming kernel on TPU,
     the jnp formulation elsewhere (pass explicitly to override; off-TPU
@@ -128,6 +131,8 @@ def stencil5(d: DArray, iters: int = 1,
     HBM traffic per step ~``temporal``-fold.  Defaults to an auto depth
     (up to 8) when the layout supports it; pass 1 to force the streaming
     single-step kernel."""
+    from ..ops.pallas_stencil import _canon_weights
+    w = _canon_weights(weights)
     iters = int(iters)
     if use_pallas is None:
         from ..ops.pallas_gemm import _on_tpu
@@ -156,8 +161,18 @@ def stencil5(d: DArray, iters: int = 1,
                     f"temporal={temporal} unsupported for this layout "
                     f"(local block {m_local}x{d.dims[1]} {d.dtype})")
     mesh, pids = _row_mesh(d)
-    res = _stencil_jit(mesh, iters, bool(use_pallas), kt)(d.garray)
+    res = _stencil_jit(mesh, iters, bool(use_pallas), kt, w)(d.garray)
     return _wrap_global(res, procs=pids, dist=list(d.pids.shape))
+
+
+def stencil5(d: DArray, iters: int = 1,
+             use_pallas: bool | None = None,
+             temporal: int | None = None) -> DArray:
+    """``iters`` 5-point Laplacian steps with zero boundary — the
+    reference pattern (docs/src/index.md:160-181), as ``stencil3x3`` with
+    the Laplacian weights.  See ``stencil3x3`` for the knobs."""
+    from ..ops.pallas_stencil import LAPLACIAN_3X3
+    return stencil3x3(d, LAPLACIAN_3X3, iters, use_pallas, temporal)
 
 
 # ---------------------------------------------------------------------------

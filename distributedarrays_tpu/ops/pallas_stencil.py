@@ -34,9 +34,49 @@ from jax.experimental import pallas as pl
 
 from .pallas_gemm import _on_tpu, _pow2_divisor
 
-__all__ = ["stencil5_block", "stencil5_multistep", "supports"]
+__all__ = ["stencil5_block", "stencil5_multistep", "stencil3x3_block",
+           "stencil3x3_multistep", "supports", "LAPLACIAN_3X3"]
 
 _VMEM_TARGET = 2 * 1024 * 1024  # ~per-buffer VMEM budget for (bm, n) tiles
+
+# the 5-point Laplacian as a 3x3 stencil: out[i,j] = sum_ab w[a][b] *
+# x[i-1+a, j-1+b] with zero boundary
+LAPLACIAN_3X3 = ((0.0, 1.0, 0.0), (1.0, -4.0, 1.0), (0.0, 1.0, 0.0))
+
+
+def _canon_weights(weights) -> tuple:
+    """Validate + canonicalize a 3x3 weight stencil to a hashable tuple
+    of floats (the kernels bake weights in as compile-time constants)."""
+    import numpy as _np
+    w = _np.asarray(weights, dtype=_np.float64)
+    if w.shape != (3, 3):
+        raise ValueError(f"stencil weights must be 3x3; got {w.shape}")
+    return tuple(tuple(float(v) for v in row) for row in w)
+
+
+def _apply3x3(ext, w):
+    """One weighted-stencil step on row-extended ``ext`` ((r + 2, n): one
+    neighbor row above and below the r output rows); zero column boundary.
+    Zero weights cost nothing (static) and unit weights skip the multiply."""
+    bands = (ext[:-2], ext[1:-1], ext[2:])              # rows i-1, i, i+1
+    acc = None
+    for bi in range(3):
+        band = bands[bi]
+        zc = jnp.zeros_like(band[:, :1])
+        for ci, wv in enumerate(w[bi]):
+            if wv == 0.0:
+                continue
+            if ci == 0:      # contribution of column j-1
+                t = jnp.concatenate([zc, band[:, :-1]], axis=1)
+            elif ci == 2:    # contribution of column j+1
+                t = jnp.concatenate([band[:, 1:], zc], axis=1)
+            else:
+                t = band
+            term = t if wv == 1.0 else ext.dtype.type(wv) * t
+            acc = term if acc is None else acc + term
+    if acc is None:          # all-zero stencil
+        acc = jnp.zeros_like(ext[1:-1])
+    return acc
 
 
 def _plan(m: int, n: int, itemsize: int, block_rows: int | None,
@@ -69,21 +109,17 @@ def supports(m: int, n: int, dtype, k: int = 0) -> bool:
     return _plan(m, n, jnp.dtype(dtype).itemsize, None, k) is not None
 
 
-def _kernel(mid_ref, top_ref, bot_ref, o_ref):
+def _kernel(mid_ref, top_ref, bot_ref, o_ref, *, w):
     c = mid_ref[...]                                    # (bm, n)
-    up = jnp.concatenate([top_ref[0], c[:-1]], axis=0)
-    down = jnp.concatenate([c[1:], bot_ref[0]], axis=0)
-    z = jnp.zeros_like(c[:, :1])
-    left = jnp.concatenate([z, c[:, :-1]], axis=1)
-    right = jnp.concatenate([c[:, 1:], z], axis=1)
-    o_ref[...] = up + down + left + right - 4.0 * c
+    ext = jnp.concatenate([top_ref[0], c, bot_ref[0]], axis=0)
+    o_ref[...] = _apply3x3(ext, w)
 
 
 @functools.lru_cache(maxsize=64)
-def _build(m, n, bm, dtype_str, interpret):
+def _build(m, n, bm, dtype_str, interpret, w):
     nb = m // bm
     call = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, w=w),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((bm, n), lambda i: (i, 0)),    # resident block
@@ -100,20 +136,26 @@ def _build(m, n, bm, dtype_str, interpret):
     return call
 
 
-def stencil5_block(block, lo, hi, block_rows: int | None = None,
-                   interpret: bool | None = None):
-    """One 5-point Laplacian step on a local (m, n) block.
+def stencil3x3_block(block, lo, hi, weights=LAPLACIAN_3X3,
+                     block_rows: int | None = None,
+                     interpret: bool | None = None):
+    """One weighted 3x3 stencil step on a local (m, n) block:
+    ``out[i,j] = sum_ab w[a][b] * x[i-1+a, j-1+b]`` with zero column
+    boundary.  Weights are compile-time constants (zero entries cost
+    nothing), so the 5-point Laplacian, diffusion steps, blurs, and
+    sharpen filters all stream through the same kernel.
 
     ``lo``/``hi``: the (1, n) halo rows from the neighboring ranks (zeros
     at the outer boundary) — exactly what ``halo_exchange`` returns.
-    Semantics match models/stencil.py's jnp step: zero column boundary,
-    ``up + down + left + right - 4*center``.
+    Diagonal taps read column-shifts of those same full-width rows, so no
+    corner exchange is needed on a row-sharded layout.
 
     ``block_rows`` defaults to whatever keeps one (bm, n) buffer around
     2 MB — the kernel body materializes several such temporaries plus the
     double-buffered in/out blocks, and a full-width 8192² f32 block at 512
     rows blows the 16 MB VMEM scoped limit.
     """
+    w = _canon_weights(weights)
     m, n = block.shape
     if lo.shape != (1, n) or hi.shape != (1, n):
         raise ValueError(f"halo rows must be (1, {n}); got {lo.shape}, "
@@ -121,7 +163,7 @@ def stencil5_block(block, lo, hi, block_rows: int | None = None,
     bm = _plan(m, n, block.dtype.itemsize, block_rows)
     if bm is None:
         raise ValueError(
-            f"stencil5_block has no TPU-valid tiling for ({m}, {n}) "
+            f"stencil3x3_block has no TPU-valid tiling for ({m}, {n}) "
             f"{block.dtype}: needs a power-of-two row divisor >= 8 within "
             "the VMEM budget, or a whole block small enough to process in "
             "one step; use the jnp path (use_pallas=False) for this layout")
@@ -136,8 +178,16 @@ def stencil5_block(block, lo, hi, block_rows: int | None = None,
         bot_rows = jnp.concatenate([block[bm::bm], hi], axis=0)
     else:
         top_rows, bot_rows = lo, hi
-    return _build(m, n, bm, str(block.dtype), bool(interpret))(
+    return _build(m, n, bm, str(block.dtype), bool(interpret), w)(
         block, top_rows[:, None, :], bot_rows[:, None, :])
+
+
+def stencil5_block(block, lo, hi, block_rows: int | None = None,
+                   interpret: bool | None = None):
+    """One 5-point Laplacian step (``stencil3x3_block`` with the
+    Laplacian weights; semantics match models/stencil.py's jnp step)."""
+    return stencil3x3_block(block, lo, hi, LAPLACIAN_3X3, block_rows,
+                            interpret)
 
 
 # ---------------------------------------------------------------------------
@@ -160,7 +210,7 @@ def stencil5_block(block, lo, hi, block_rows: int | None = None,
 # ---------------------------------------------------------------------------
 
 
-def _kernel_multi(buf_ref, topf_ref, botf_ref, o_ref, *, k, bm, m):
+def _kernel_multi(buf_ref, topf_ref, botf_ref, o_ref, *, k, bm, m, w):
     x = buf_ref[0]                                      # (bm + 2k, n)
     i = pl.program_id(0)
     top_d = topf_ref[0, 0] != 0
@@ -174,20 +224,16 @@ def _kernel_multi(buf_ref, topf_ref, botf_ref, o_ref, *, k, bm, m):
     keep = jnp.where(ghost, 0, 1).astype(x.dtype)       # (bm + 2k, 1)
     for _ in range(k):
         zr = jnp.zeros_like(x[:1])
-        up = jnp.concatenate([zr, x[:-1]], axis=0)
-        down = jnp.concatenate([x[1:], zr], axis=0)
-        zc = jnp.zeros_like(x[:, :1])
-        left = jnp.concatenate([zc, x[:, :-1]], axis=1)
-        right = jnp.concatenate([x[:, 1:], zc], axis=1)
-        x = (up + down + left + right - 4.0 * x) * keep
+        ext = jnp.concatenate([zr, x, zr], axis=0)
+        x = _apply3x3(ext, w) * keep
     o_ref[...] = x[k:k + bm]
 
 
 @functools.lru_cache(maxsize=64)
-def _build_multi(m, n, bm, k, dtype_str, interpret):
+def _build_multi(m, n, bm, k, dtype_str, interpret, w):
     nb = m // bm
     return pl.pallas_call(
-        functools.partial(_kernel_multi, k=k, bm=bm, m=m),
+        functools.partial(_kernel_multi, k=k, bm=bm, m=m, w=w),
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((1, bm + 2 * k, n), lambda i: (i, 0, 0)),
@@ -200,19 +246,21 @@ def _build_multi(m, n, bm, k, dtype_str, interpret):
     )
 
 
-def stencil5_multistep(block, lo, hi, k: int, top_dirichlet, bot_dirichlet,
-                       block_rows: int | None = None,
-                       interpret: bool | None = None):
-    """``k`` 5-point Laplacian steps on a local (m, n) block in ONE kernel
-    launch (temporal blocking — see the scheme note above).
+def stencil3x3_multistep(block, lo, hi, k: int, top_dirichlet,
+                         bot_dirichlet, weights=LAPLACIAN_3X3,
+                         block_rows: int | None = None,
+                         interpret: bool | None = None):
+    """``k`` weighted 3x3 stencil steps on a local (m, n) block in ONE
+    kernel launch (temporal blocking — see the scheme note above; the
+    trapezoid/ghost-shrink argument is weight-agnostic).
 
     ``lo``/``hi``: the (k, n) step-0 halo slabs from the neighboring ranks
     (``halo_exchange(..., halo=k)``; zeros at the global edge).
     ``top_dirichlet``/``bot_dirichlet``: scalars (python or traced bools),
     true when this rank's top/bottom edge is the global zero boundary —
     inside ``shard_map`` pass ``axis_index == 0`` / ``== nranks - 1``.
-    Semantics match ``k`` applications of models/stencil.py's jnp step.
     """
+    w = _canon_weights(weights)
     m, n = block.shape
     k = int(k)
     if k < 1:
@@ -223,7 +271,7 @@ def stencil5_multistep(block, lo, hi, k: int, top_dirichlet, bot_dirichlet,
     bm = _plan(m, n, block.dtype.itemsize, block_rows, k)
     if bm is None:
         raise ValueError(
-            f"stencil5_multistep has no TPU-valid tiling for ({m}, {n}) "
+            f"stencil3x3_multistep has no TPU-valid tiling for ({m}, {n}) "
             f"{block.dtype} at k={k}; use the jnp path (use_pallas=False) "
             "for this layout")
     if interpret is None:
@@ -235,5 +283,16 @@ def stencil5_multistep(block, lo, hi, k: int, top_dirichlet, bot_dirichlet,
     row_idx = (jnp.arange(nb) * bm)[:, None] + jnp.arange(bm + 2 * k)[None, :]
     buf = jnp.take(extended, row_idx, axis=0)            # (nb, bm+2k, n)
     flag = lambda v: jnp.asarray(v).reshape(1, 1).astype(block.dtype)
-    return _build_multi(m, n, bm, k, str(block.dtype), bool(interpret))(
+    return _build_multi(m, n, bm, k, str(block.dtype), bool(interpret), w)(
         buf, flag(top_dirichlet), flag(bot_dirichlet))
+
+
+def stencil5_multistep(block, lo, hi, k: int, top_dirichlet, bot_dirichlet,
+                       block_rows: int | None = None,
+                       interpret: bool | None = None):
+    """``k`` 5-point Laplacian steps in one launch (the Laplacian special
+    case of ``stencil3x3_multistep``; semantics match ``k`` applications
+    of models/stencil.py's jnp step)."""
+    return stencil3x3_multistep(block, lo, hi, k, top_dirichlet,
+                                bot_dirichlet, LAPLACIAN_3X3, block_rows,
+                                interpret)

@@ -83,6 +83,55 @@ def test_stencil5_pallas_odd_rows(rng):
         stencil5_block(big, zb, zb)
 
 
+def _apply3x3_np(A, w):
+    w = np.asarray(w, np.float32)
+    xp = np.pad(A, 1)
+    out = np.zeros_like(A)
+    for a in range(3):
+        for b in range(3):
+            out += w[a, b] * xp[a:a + A.shape[0], b:b + A.shape[1]]
+    return out
+
+
+def test_stencil3x3_matches_oracle(rng):
+    # arbitrary weights (incl. diagonal taps) through the jnp path, the
+    # streaming kernel, and temporal blocking, vs a numpy oracle
+    from distributedarrays_tpu.models.stencil import stencil3x3
+    w = rng.standard_normal((3, 3)).astype(np.float32)
+    A = rng.standard_normal((64, 32)).astype(np.float32)
+    want = A
+    for _ in range(4):
+        want = _apply3x3_np(want, w)
+    d = dat.distribute(A, procs=range(8), dist=(8, 1))
+    got_jnp = np.asarray(stencil3x3(d, w, iters=4, use_pallas=False))
+    assert np.allclose(got_jnp, want, rtol=1e-3, atol=1e-3)
+    d2 = dat.distribute(A, procs=range(8), dist=(8, 1))
+    got_k = np.asarray(stencil3x3(d2, w, iters=4, use_pallas=True,
+                                  temporal=1))
+    assert np.allclose(got_k, want, rtol=1e-3, atol=1e-3)
+    got_t = np.asarray(stencil3x3(d2, w, iters=4, use_pallas=True,
+                                  temporal=4))
+    assert np.allclose(got_t, want, rtol=1e-3, atol=1e-3)
+
+
+def test_stencil3x3_weight_validation():
+    from distributedarrays_tpu.models.stencil import stencil3x3
+    d = dat.dzeros((16, 16), procs=range(8), dist=(8, 1))
+    with pytest.raises(ValueError, match="3x3"):
+        stencil3x3(d, np.ones((2, 2)))
+
+
+def test_stencil5_is_laplacian_3x3(rng):
+    # stencil5 must be exactly the Laplacian instance of stencil3x3
+    from distributedarrays_tpu.models.stencil import stencil3x3
+    from distributedarrays_tpu.ops.pallas_stencil import LAPLACIAN_3X3
+    A = rng.standard_normal((32, 32)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(8, 1))
+    a = np.asarray(stencil.stencil5(d, iters=2, use_pallas=False))
+    b = np.asarray(stencil3x3(d, LAPLACIAN_3X3, iters=2, use_pallas=False))
+    assert np.array_equal(a, b)
+
+
 def test_stencil5_temporal_matches_oracle(rng):
     # temporal blocking (k steps per launch, depth-k ghost zones) must be
     # bit-exact vs iterating the jnp step: k dividing iters, a remainder
@@ -108,7 +157,9 @@ def test_stencil5_temporal_single_rank_dirichlet(rng):
     d = dat.distribute(A, procs=[0], dist=(1, 1))
     got = np.asarray(stencil.stencil5(d, iters=7, use_pallas=True,
                                       temporal=4))
-    assert np.allclose(got, want, rtol=1e-4, atol=1e-4)
+    # 7 chained f32 steps amplify values ~8^7x; summation-order rounding
+    # accumulates, so the bound is relative
+    assert np.allclose(got, want, rtol=1e-3, atol=1e-3)
 
 
 def test_stencil5_temporal_ghost_deeper_than_block(rng):
@@ -124,7 +175,9 @@ def test_stencil5_temporal_ghost_deeper_than_block(rng):
     z = jnp.zeros((k, 128), jnp.float32)
     got = np.asarray(stencil5_multistep(jnp.asarray(A), z, z, k,
                                         True, True, block_rows=8))
-    assert np.allclose(got, want, rtol=1e-3, atol=1e-3)
+    # k chained f32 steps blow values up ~8^k; bound error by the array
+    # scale (near-cancelled entries are relatively inaccurate by nature)
+    assert np.abs(got - want).max() <= 1e-5 * np.abs(want).max()
 
 
 def test_stencil5_multistep_vmem_refusal():
