@@ -372,6 +372,47 @@ def main():
 
     _guarded(details, "flash_attn", cfg_flash)
 
+    # ---- extra: flash-attention block autotune sweep ---------------------
+    # sweeps (block_q, block_k) at the bench shape, records the winner in
+    # the autotune registry (consulted by flash_attention when blocks are
+    # unspecified), and reports the tuned TFLOPS
+    def cfg_flash_tune():
+        from distributedarrays_tpu.ops.pallas_attention import flash_attention
+        from distributedarrays_tpu.utils import autotune
+        SQ, HQ, DQ = 8192, 8, 64
+        q = jax.random.normal(jax.random.key(1), (SQ, HQ, DQ), jnp.bfloat16)
+
+        def timer(cfg):
+            bq, bk = cfg
+
+            def fa_len(L):
+                def f():
+                    def body(x, _):
+                        return flash_attention(x, q, q, causal=True,
+                                               block_q=bq, block_k=bk), None
+                    x, _ = lax.scan(body, q, None, length=L)
+                    return jnp.sum(x.astype(jnp.float32))
+                jf = jax.jit(f)
+                float(jf())
+                return min(_t(lambda: float(jf())) for _ in range(2))
+            return _marginal(fa_len, L0=4, min_delta=0.05)
+
+        cands = [(bq, bk) for bq in (512, 1024, 2048)
+                 for bk in (512, 1024, 2048)]
+        key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, True)
+        best, results = autotune.sweep("flash_attention", key, cands, timer)
+        cache = autotune.save_default()   # future processes pick this up
+        flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
+        return {
+            "flash_attn_tuned_block": list(best),
+            "flash_attn_tuned_tflops": flops / results[best] / 1e12,
+            "flash_attn_sweep": {f"{bq}x{bk}": flops / t / 1e12
+                                 for (bq, bk), t in results.items()},
+            "autotune_cache_path": cache,
+        }
+
+    _guarded(details, "flash_attn_tune", cfg_flash_tune, timeout_s=600)
+
     # ---- extra: fused (Pallas) vs einsum ring-attention hop --------------
     # One chip = a 1-rank ring, so this isolates the per-hop compute the
     # ring pipelines against ppermute: the fused path must be >= the

@@ -503,20 +503,31 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int | None = None, block_k: int | None = None,
                     interpret: bool | None = None):
     """Exact attention over (seq, heads, head_dim) arrays without
     materializing the S×S score matrix.
 
-    Block sizes are fitted to the sequence length (clipped, then halved
-    until they divide S).  Use as the per-rank compute inside ring
-    attention, or standalone single-chip.
+    Block sizes default to the autotune registry's tuned value for this
+    (S, H, D, dtype, causal) — populated by ``utils.autotune`` sweeps
+    (bench.py runs one on hardware) — falling back to 512².  Either way
+    they are fitted to the sequence length (clipped, then halved until
+    they divide S).  Use as the per-rank compute inside ring attention,
+    or standalone single-chip.
     """
     q, k, v = (jnp.asarray(x) for x in (q, k, v))
     if q.shape != k.shape or q.shape != v.shape or q.ndim != 3:
         raise ValueError(f"q/k/v must share (S, H, D), got {q.shape}, "
                          f"{k.shape}, {v.shape}")
     S, H, D = q.shape
+    if block_q is None or block_k is None:
+        from ..utils import autotune
+        tuned = autotune.get(
+            "flash_attention",
+            autotune.key_for(S, H, D, q.dtype, bool(causal)))
+        tq, tk = tuned if tuned else (512, 512)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     bq, bk = _fit_block(block_q, S), _fit_block(block_k, S)
     if interpret is None:
         interpret = not _on_tpu()

@@ -121,6 +121,19 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     if interpret is None:
         interpret = not _on_tpu()
     if block is None:
+        from ..utils import autotune
+        tuned = autotune.get(
+            "pallas_matmul", autotune.key_for(m, n, ka, a.dtype, b.dtype))
+        if tuned:
+            tm, tn, tk = (int(v) for v in tuned)
+            # a stale/hand-edited cache entry must degrade to the auto
+            # heuristic, never break dispatch for the shape
+            if (m % tm == 0 and n % tn == 0 and ka % tk == 0
+                    and (tm % 8 == 0 or tm == m)
+                    and (tn % 128 == 0 or tn == n)
+                    and (tk % 128 == 0 or tk == ka)):
+                block = (tm, tn, tk)
+    if block is None:
         two_byte = max(jnp.dtype(a.dtype).itemsize,
                        jnp.dtype(b.dtype).itemsize) <= 2
         bm0, bn0, bk0 = (1024, 1024, 512) if two_byte else (512, 512, 512)

@@ -280,3 +280,70 @@ def test_ckpt_manager_ignores_partial_tmp_dirs(tmp_path):
     (tmp_path / "run" / "step_00000009").mkdir()   # no meta -> incomplete
     assert mgr.steps() == [1]
     assert mgr.restore()["v"] == 1
+
+
+# ---------------------------------------------------------------------------
+# autotune registry
+# ---------------------------------------------------------------------------
+
+
+def _isolate_autotune(monkeypatch, tmp_path):
+    # keep the test blind to any real tuning cache in the repo root
+    from distributedarrays_tpu.utils import autotune
+    monkeypatch.setenv("DAT_AUTOTUNE_CACHE", str(tmp_path / "none.json"))
+    monkeypatch.setattr(autotune, "_LOADED_ENV", True)
+    autotune.clear()
+    return autotune
+
+
+def test_autotune_registry_roundtrip(tmp_path, monkeypatch):
+    autotune = _isolate_autotune(monkeypatch, tmp_path)
+    key = autotune.key_for(8192, 8, 64, "bfloat16", True)
+    assert autotune.get("flash_attention", key) is None
+    autotune.record("flash_attention", key, [1024, 2048])
+    assert autotune.get("flash_attention", key) == [1024, 2048]
+    p = str(tmp_path / "cache.json")
+    autotune.save(p)
+    autotune.clear()
+    assert autotune.get("flash_attention", key) is None
+    autotune.load(p)
+    assert autotune.get("flash_attention", key) == [1024, 2048]
+    autotune.clear()
+
+
+def test_autotune_sweep_picks_best_and_skips_invalid(tmp_path, monkeypatch):
+    autotune = _isolate_autotune(monkeypatch, tmp_path)
+    times = {(256, 256): 0.5, (512, 512): 0.2}
+
+    def timer(cfg):
+        if cfg == (1024, 1024):
+            raise ValueError("invalid tiling")
+        return times[cfg]
+
+    best, results = autotune.sweep(
+        "k", "key", [(256, 256), (512, 512), (1024, 1024)], timer)
+    assert best == (512, 512)
+    assert (1024, 1024) not in results
+    assert autotune.get("k", "key") == (512, 512)
+    autotune.clear()
+    with pytest.raises(RuntimeError, match="boom"):
+        autotune.sweep("k", "key2", [(1, 1)],
+                       lambda c: (_ for _ in ()).throw(RuntimeError("boom")))
+
+
+def test_flash_attention_consults_autotune(rng, tmp_path, monkeypatch):
+    # tuned block sizes must flow into the kernel when blocks are left
+    # unspecified — verified by recording a tune and checking the result
+    # still matches the dense oracle (the tuned path must be correct, not
+    # just selected)
+    from distributedarrays_tpu.ops.pallas_attention import flash_attention
+    autotune = _isolate_autotune(monkeypatch, tmp_path)
+    import jax.numpy as jnp
+    S, H, D = 256, 2, 32
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    base = np.asarray(flash_attention(q, q, q, block_q=128, block_k=128))
+    key = autotune.key_for(S, H, D, q.dtype, False)
+    autotune.record("flash_attention", key, (64, 64))
+    tuned = np.asarray(flash_attention(q, q, q))
+    autotune.clear()
+    assert np.allclose(base, tuned, rtol=1e-4, atol=1e-4)
