@@ -104,7 +104,7 @@ def _save(details):
 
 
 _START = time.monotonic()
-_GLOBAL_BUDGET_S = 2400.0   # leave headroom under the driver's own timeout
+_GLOBAL_BUDGET_S = 3000.0   # leave headroom under the driver's own timeout
 
 
 def _guarded(details, label, fn, timeout_s=420.0):
@@ -477,6 +477,76 @@ def main():
                 "pallas_gemm_4096_bf16_tflops": 2 * 4096**3 / t_pg / 1e12}
 
     _guarded(details, "pallas_gemm", cfg_pallas_gemm)
+
+    # ---- extra: flash-attention TRAINING step (fwd+bwd, FA2 custom-vjp) --
+    def cfg_flash_train():
+        from distributedarrays_tpu.ops.pallas_attention import flash_attention
+        ST, HT, DT = 8192, 8, 64
+        qt = jax.random.normal(jax.random.key(5), (ST, HT, DT), jnp.bfloat16)
+
+        def grad_len(L):
+            def one(x):
+                return jnp.sum(flash_attention(x, x, x, causal=True,
+                                               block_q=1024, block_k=1024)
+                               .astype(jnp.float32))
+            g = jax.grad(one)
+
+            def f():
+                def body(x, _):
+                    return (x + 1e-6 * g(x).astype(x.dtype)), None
+                x, _ = lax.scan(body, qt, None, length=L)
+                return jnp.sum(x.astype(jnp.float32))
+            jf = jax.jit(f)
+            float(jf())
+            return min(_t(lambda: float(jf())) for _ in range(2))
+
+        t_tr = _marginal(grad_len, L0=2, min_delta=0.05)
+        # fwd 2 matmuls + bwd 5 -> 3.5x the fwd matmul flops, causal half
+        flops = 3.5 * (2 * 2 * ST * ST * DT * HT / 2)
+        return {"flash_train_8k_bf16_marginal_s": t_tr,
+                "flash_train_8k_bf16_tflops": flops / t_tr / 1e12}
+
+    _guarded(details, "flash_train", cfg_flash_train)
+
+    # ---- extra: full transformer train step (flagship model) -------------
+    def cfg_transformer_train():
+        from distributedarrays_tpu.models import transformer as T
+        cfg = T.Config(vocab=8192, dim=1024, heads=16, layers=8,
+                       ffn_mult=4, max_seq=2048, dtype=jnp.bfloat16)
+        params = T.init_params(jax.random.key(0), cfg)
+        B, S = 4, 2048
+        toks = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab)
+        lr = jnp.float32(1e-4)
+
+        def steps_len(L):
+            @jax.jit
+            def f(p):
+                def body(p, _):
+                    loss, g = jax.value_and_grad(T.loss_fn)(p, toks, cfg)
+                    p = jax.tree_util.tree_map(
+                        lambda w, gg: (w.astype(jnp.float32)
+                                       - lr * gg.astype(jnp.float32))
+                        .astype(w.dtype), p, g)
+                    return p, loss
+                p, losses = lax.scan(body, p, None, length=L)
+                return losses[-1]
+            float(f(params))
+            return min(_t(lambda: float(f(params))) for _ in range(2))
+
+        t_step = _marginal(steps_len, L0=2, min_delta=0.1)
+        nparams = sum(int(np.prod(x.shape))
+                      for x in jax.tree_util.tree_leaves(params))
+        toks_per_step = B * (S - 1)
+        return {
+            "transformer_train_step_s": t_step,
+            "transformer_train_tokens_per_s": toks_per_step / t_step,
+            "transformer_train_params": nparams,
+            "transformer_train_tflops_est":
+                6 * nparams * toks_per_step / t_step / 1e12,
+        }
+
+    _guarded(details, "transformer_train", cfg_transformer_train,
+             timeout_s=600)
 
     # ---- extra: distributed sort over 1e7 elements -----------------------
     def cfg_sort():

@@ -525,7 +525,13 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
         tuned = autotune.get(
             "flash_attention",
             autotune.key_for(S, H, D, q.dtype, bool(causal)))
-        tq, tk = tuned if tuned else (512, 512)
+        tq = tk = 512
+        try:   # a malformed cache entry degrades to the default, never
+            a, b = tuned                            # breaks dispatch
+            if int(a) > 0 and int(b) > 0:
+                tq, tk = int(a), int(b)
+        except Exception:
+            pass
         block_q = tq if block_q is None else block_q
         block_k = tk if block_k is None else block_k
     bq, bk = _fit_block(block_q, S), _fit_block(block_k, S)

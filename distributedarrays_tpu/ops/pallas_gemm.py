@@ -124,15 +124,18 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
         from ..utils import autotune
         tuned = autotune.get(
             "pallas_matmul", autotune.key_for(m, n, ka, a.dtype, b.dtype))
-        if tuned:
+        # a stale/hand-edited/malformed cache entry must degrade to the
+        # auto heuristic, never break dispatch for the shape
+        try:
             tm, tn, tk = (int(v) for v in tuned)
-            # a stale/hand-edited cache entry must degrade to the auto
-            # heuristic, never break dispatch for the shape
-            if (m % tm == 0 and n % tn == 0 and ka % tk == 0
+            if (tm > 0 and tn > 0 and tk > 0
+                    and m % tm == 0 and n % tn == 0 and ka % tk == 0
                     and (tm % 8 == 0 or tm == m)
                     and (tn % 128 == 0 or tn == n)
                     and (tk % 128 == 0 or tk == ka)):
                 block = (tm, tn, tk)
+        except Exception:
+            pass
     if block is None:
         two_byte = max(jnp.dtype(a.dtype).itemsize,
                        jnp.dtype(b.dtype).itemsize) <= 2

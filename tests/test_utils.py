@@ -288,11 +288,14 @@ def test_ckpt_manager_ignores_partial_tmp_dirs(tmp_path):
 
 
 def _isolate_autotune(monkeypatch, tmp_path):
-    # keep the test blind to any real tuning cache in the repo root
+    # keep the test blind to any real tuning cache in the repo root, and
+    # guarantee the process-global registry is wiped even when the test
+    # body fails mid-way (in-body clear() would be skipped)
     from distributedarrays_tpu.utils import autotune
     monkeypatch.setenv("DAT_AUTOTUNE_CACHE", str(tmp_path / "none.json"))
     monkeypatch.setattr(autotune, "_LOADED_ENV", True)
     autotune.clear()
+    monkeypatch.setattr(autotune, "_REGISTRY", {})
     return autotune
 
 
@@ -344,6 +347,39 @@ def test_flash_attention_consults_autotune(rng, tmp_path, monkeypatch):
     base = np.asarray(flash_attention(q, q, q, block_q=128, block_k=128))
     key = autotune.key_for(S, H, D, q.dtype, False)
     autotune.record("flash_attention", key, (64, 64))
+    # spy: the kernel must ask the registry with exactly this key
+    calls = []
+    real_get = autotune.get
+
+    def spy(kernel, k, default=None):
+        calls.append((kernel, k))
+        return real_get(kernel, k, default)
+
+    monkeypatch.setattr(
+        "distributedarrays_tpu.utils.autotune.get", spy)
     tuned = np.asarray(flash_attention(q, q, q))
-    autotune.clear()
+    assert ("flash_attention", key) in calls, calls
     assert np.allclose(base, tuned, rtol=1e-4, atol=1e-4)
+    # malformed entries must degrade to the default, not crash dispatch
+    for bad in ([1024], [0, 0], "junk", None):
+        autotune.record("flash_attention", key, bad)
+        out = np.asarray(flash_attention(q, q, q))
+        assert np.allclose(base, out, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_matmul_malformed_tuned_entry_degrades(rng, tmp_path,
+                                                      monkeypatch):
+    from distributedarrays_tpu.ops.pallas_gemm import pallas_matmul
+    autotune = _isolate_autotune(monkeypatch, tmp_path)
+    import jax.numpy as jnp
+    a = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
+    want = np.asarray(a) @ np.asarray(a)
+    key = autotune.key_for(256, 256, 256, a.dtype, a.dtype)
+    for bad in ([256, 256], [0, 0, 0], [7, 13, 99], "junk"):
+        autotune.record("pallas_matmul", key, bad)
+        got = np.asarray(pallas_matmul(a, a))
+        assert np.allclose(got, want, rtol=1e-4, atol=1e-3)
+    # and a VALID tuned entry is honored (same numerics)
+    autotune.record("pallas_matmul", key, [128, 128, 128])
+    got = np.asarray(pallas_matmul(a, a))
+    assert np.allclose(got, want, rtol=1e-4, atol=1e-3)
