@@ -163,10 +163,12 @@ def main():
 
     # keep the previous run's banked numbers recoverable: this run's first
     # _save overwrites the file, and a wedge mid-run must not cost the
-    # last full run's evidence
+    # last full run's evidence (copy, not rename — the tracked file must
+    # never transiently disappear from the working tree)
     cur = Path(__file__).with_name("BENCH_DETAILS.json")
     if cur.exists():
-        cur.replace(cur.with_name("BENCH_DETAILS_prev.json"))
+        import shutil
+        shutil.copyfile(cur, cur.with_name("BENCH_DETAILS_prev.json"))
 
     ndev = len(jax.devices())
     details = {"devices": [str(d) for d in jax.devices()]}
