@@ -364,6 +364,136 @@ def zigzag_ring_attention_kernel(q, k, v, axis: str,
     return jnp.transpose(jnp.concatenate(outs, axis=1), (1, 0, 2))
 
 
+def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
+                                       scale: float | None = None,
+                                       block_q: int = 512,
+                                       block_k: int = 512,
+                                       interpret: bool | None = None):
+    """Fused zigzag ring attention: the quadrant schedule of
+    ``zigzag_ring_attention_kernel`` with each computed quadrant running
+    as ONE Pallas flash hop (``flash_attention_hop`` on half-blocks, the
+    online-softmax carry flowing around the ring).  Cross quadrants use
+    the maskless kernel; diagonal quadrants the causal kernel with global
+    chunk offsets.  Forward-only (use ``zigzag_ring_attention_kernel``
+    for the differentiable path).
+    """
+    from ..ops.pallas_attention import flash_attention_hop, flash_carry_init
+
+    nblk = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, h, dh = q.shape
+    if b % 2:
+        raise ValueError(f"zigzag needs an even local block; got {b}")
+    half = b // 2
+    sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
+
+    qh = jnp.transpose(q, (1, 0, 2))                     # (h, b, dh)
+    kh = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    q1, q2 = qh[:, :half], qh[:, half:]
+    qoff1 = me * half
+    qoff2 = (2 * nblk - 1 - me) * half
+
+    def hop(causal_, qx, kx, vx, carry, qoff, koff):
+        m, l, a = carry
+        return flash_attention_hop(qx, kx, vx, m, l, a, qoff, koff,
+                                   causal=causal_, scale=sc,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret)
+
+    init = flash_carry_init(h, half, dh)
+
+    def accumulate(step, c1, c2, kc, vc):
+        src = (me - step) % nblk
+        k1, v1 = kc[:, :half], vc[:, :half]
+        k2, v2 = kc[:, half:], vc[:, half:]
+        koff1 = src * half
+        koff2 = (2 * nblk - 1 - src) * half
+        # q2 x k1: always fully unmasked
+        c2 = hop(False, q2, k1, v1, c2, qoff2, koff1)
+
+        def lt(ops):
+            c1, c2, k1, v1, k2, v2 = ops
+            return hop(False, q1, k1, v1, c1, qoff1, koff1), c2
+
+        def eq(ops):
+            c1, c2, k1, v1, k2, v2 = ops
+            return (hop(True, q1, k1, v1, c1, qoff1, koff1),
+                    hop(True, q2, k2, v2, c2, qoff2, koff2))
+
+        def gt(ops):
+            c1, c2, k1, v1, k2, v2 = ops
+            return c1, hop(False, q2, k2, v2, c2, qoff2, koff2)
+
+        idx = jnp.clip(jnp.sign(src - me) + 1, 0, 2).astype(jnp.int32)
+        c1, c2 = lax.switch(idx, (lt, eq, gt), (c1, c2, k1, v1, k2, v2))
+        return c1, c2
+
+    perm = [(i, (i + 1) % nblk) for i in range(nblk)]
+
+    def body(step, carry):
+        c1, c2, kc, vc = carry
+        c1, c2 = accumulate(step, c1, c2, kc, vc)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        return c1, c2, kc, vc
+
+    c1, c2, kc, vc = lax.fori_loop(0, nblk - 1, body, (init, init, kh, vh))
+    c1, c2 = accumulate(nblk - 1, c1, c2, kc, vc)
+
+    outs = []
+    for m, l, a in (c1, c2):
+        ln = l[:, :, :1]
+        ln = jnp.where(ln == 0.0, 1.0, ln)
+        outs.append((a / ln).astype(q.dtype))            # (h, half, dh)
+    return jnp.transpose(jnp.concatenate(outs, axis=1), (1, 0, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _zigzag_flash_jit(mesh, block_q: int, block_k: int):
+    axis = mesh.axis_names[0]
+    spec = P(axis, None, None)
+
+    def fn(q, k, v):
+        return zigzag_ring_flash_attention_kernel(q, k, v, axis,
+                                                  block_q=block_q,
+                                                  block_k=block_k)
+
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec, check_vma=False))
+
+
+def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
+                                block_q: int = 512,
+                                block_k: int = 512) -> DArray:
+    """Fused (Pallas per-quadrant) zigzag causal ring attention over
+    zigzag-ordered sequence-sharded DArrays — the performance path of
+    ``zigzag_ring_attention``."""
+    for name, a in (("q", q), ("k", k), ("v", v)):
+        if a.ndim != 3:
+            raise ValueError(f"{name} must be (seq, heads, head_dim), "
+                             f"got {a.dims}")
+        if a.dims != q.dims:
+            raise ValueError("q, k, v dims must match")
+    pids = [int(p) for p in q.pids.flat]
+    n = len(pids)
+    if q.pids.shape[0] != n or q.dims[0] % (2 * n) != 0:
+        raise ValueError(
+            "zigzag ring attention needs the sequence dim divisible by "
+            f"2*nranks over a 1-D grid; got grid {q.pids.shape} for dims "
+            f"{q.dims}")
+    half = q.dims[0] // (2 * n)
+    bq = min(block_q, half)
+    bk = min(block_k, half)
+    while half % bq:
+        bq //= 2
+    while half % bk:
+        bk //= 2
+    mesh = L.mesh_for(pids, (n, 1, 1))
+    out = _zigzag_flash_jit(mesh, bq, bk)(q.garray, k.garray, v.garray)
+    return _wrap_global(out, procs=pids, dist=[n, 1, 1])
+
+
 @functools.lru_cache(maxsize=32)
 def _zigzag_jit(mesh):
     axis = mesh.axis_names[0]
