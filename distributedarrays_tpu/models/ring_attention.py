@@ -43,6 +43,8 @@ from ..darray import DArray, _wrap_global
 __all__ = ["ring_attention", "ring_attention_kernel",
            "ring_flash_attention", "ring_flash_attention_kernel",
            "zigzag_ring_attention", "zigzag_ring_attention_kernel",
+           "zigzag_ring_flash_attention",
+           "zigzag_ring_flash_attention_kernel",
            "zigzag_order", "zigzag_shard", "zigzag_unshard",
            "reference_attention"]
 
@@ -146,22 +148,13 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])
 
 
-def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
-                                scale: float | None = None,
-                                block_q: int = 512, block_k: int = 512,
-                                interpret: bool | None = None):
-    """Fused ring attention: each hop's blockwise accumulate is ONE Pallas
-    flash program (VMEM-resident online softmax, no (h, b, b) score
-    materialization in HBM) and the online-softmax carry (m, l, acc) flows
-    around the ``ppermute`` ring.  XLA schedules the next hop's K/V
-    permute concurrently with the current hop's kernel, overlapping ICI
-    with MXU compute (VERDICT round-2 item 7 / design.md round-2 item 5).
-
-    q, k, v: ``(block, heads, d)`` — the calling rank's sequence block,
-    inside ``shard_map``.  Forward-only (use ``ring_attention_kernel`` for
-    the differentiable path).
-    """
-    from ..ops.pallas_attention import flash_attention_hop, flash_carry_init
+def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,
+                         interpret):
+    """Shared fused-ring forward.  Returns ``(out (b,h,d), oh (h,b,d),
+    lse (h,b))`` — the latter two are the FA2 backward's residuals."""
+    from ..ops.pallas_attention import (flash_attention_hop,
+                                       flash_carry_finalize,
+                                       flash_carry_init)
 
     nblk = lax.axis_size(axis)
     me = lax.axis_index(axis)
@@ -193,10 +186,105 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
 
     m, l, a, kc, vc = lax.fori_loop(0, nblk - 1, body, (m0, l0, a0, kh, vh))
     m, l, a = hop(nblk - 1, m, l, a, kc, vc)
-    ln = l[:, :, :1]                                         # (h, b, 1)
-    ln = jnp.where(ln == 0.0, 1.0, ln)
-    out = (a / ln).astype(q.dtype)                           # (h, b, dh)
-    return jnp.transpose(out, (1, 0, 2))                     # (b, h, dh)
+    oh, lse = flash_carry_finalize(m, l, a, q.dtype)
+    return jnp.transpose(oh, (1, 0, 2)), oh, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash_core(q, k, v, axis, causal, scale, block_q, block_k,
+                     interpret):
+    out, _, _ = _ring_flash_fwd_loop(q, k, v, axis, causal, scale,
+                                     block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_core_fwd(q, k, v, axis, causal, scale, block_q, block_k,
+                         interpret):
+    out, oh, lse = _ring_flash_fwd_loop(q, k, v, axis, causal, scale,
+                                        block_q, block_k, interpret)
+    return out, (q, k, v, oh, lse)
+
+
+def _ring_flash_core_bwd(axis, causal, scale, block_q, block_k, interpret,
+                         res, g):
+    # FA2 ring backward: p = exp(s - lse) is exact given the FINAL lse, so
+    # every (q block, k/v block) pair's gradient contribution is
+    # independent and additive.  Mirror the forward's ring schedule: dq
+    # accumulates locally; dk/dv accumulators TRAVEL with their k/v blocks
+    # through the same ppermute, and one extra rotation after the last hop
+    # returns each block's gradient to its home rank.
+    from ..ops.pallas_attention import _LANE, flash_attention_hop_bwd
+
+    q, k, v, oh, lse = res
+    nblk = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, h, dh = q.shape
+    sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
+
+    qh = jnp.transpose(q, (1, 0, 2))
+    kh = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    gf = jnp.transpose(g, (1, 0, 2)).astype(jnp.float32)   # (h, b, dh)
+    # dd from the FULL-precision cotangent (matches _flash_bwd); only the
+    # kernel operand gh is downcast to the MXU input dtype
+    dd = jnp.einsum("hbd,hbd->hb", gf, oh.astype(jnp.float32))
+    gh = gf.astype(q.dtype)
+    ddb = jnp.broadcast_to(dd[:, :, None], (h, b, _LANE))
+    lseb = jnp.broadcast_to(lse[:, :, None], (h, b, _LANE))
+    perm = [(i, (i + 1) % nblk) for i in range(nblk)]
+    qoff = me * b
+    zeros = lambda: jnp.zeros((h, b, dh), jnp.float32)
+
+    def hop_bwd(step, dqa, dka, dva, kc, vc):
+        koff = ((me - step) % nblk) * b
+        dqc, dkc, dvc = flash_attention_hop_bwd(
+            qh, kc, vc, gh, lseb, ddb, qoff, koff, causal=causal, scale=sc,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+        return dqa + dqc, dka + dkc, dva + dvc
+
+    def body(step, carry):
+        dqa, dka, dva, kc, vc = carry
+        dqa, dka, dva = hop_bwd(step, dqa, dka, dva, kc, vc)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        dka = lax.ppermute(dka, axis, perm)
+        dva = lax.ppermute(dva, axis, perm)
+        return dqa, dka, dva, kc, vc
+
+    dqa, dka, dva, kc, vc = lax.fori_loop(
+        0, nblk - 1, body, (zeros(), zeros(), zeros(), kh, vh))
+    dqa, dka, dva = hop_bwd(nblk - 1, dqa, dka, dva, kc, vc)
+    # block r's dk/dv sits one rank behind home after nblk-1 rotations
+    dka = lax.ppermute(dka, axis, perm)
+    dva = lax.ppermute(dva, axis, perm)
+    back = lambda t: jnp.transpose(t, (1, 0, 2)).astype(q.dtype)
+    return back(dqa), back(dka), back(dva)
+
+
+_ring_flash_core.defvjp(_ring_flash_core_fwd, _ring_flash_core_bwd)
+
+
+def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
+                                scale: float | None = None,
+                                block_q: int = 512, block_k: int = 512,
+                                interpret: bool | None = None):
+    """Fused ring attention: each hop's blockwise accumulate is ONE Pallas
+    flash program (VMEM-resident online softmax, no (h, b, b) score
+    materialization in HBM) and the online-softmax carry (m, l, acc) flows
+    around the ``ppermute`` ring.  XLA schedules the next hop's K/V
+    permute concurrently with the current hop's kernel, overlapping ICI
+    with MXU compute (VERDICT round-2 item 7 / design.md round-2 item 5).
+
+    q, k, v: ``(block, heads, d)`` — the calling rank's sequence block,
+    inside ``shard_map``.  DIFFERENTIABLE end to end: the FA2-style ring
+    backward (custom_vjp) saves only O(B) logsumexp rows per rank and
+    re-runs the ring with Pallas recompute kernels, circulating dk/dv
+    accumulators with their blocks — sequence-parallel training runs at
+    Pallas speed (VERDICT round-3 item 3).
+    """
+    sc = None if scale is None else float(scale)
+    return _ring_flash_core(q, k, v, axis, bool(causal), sc,
+                            int(block_q), int(block_k), interpret)
 
 
 @functools.lru_cache(maxsize=32)
@@ -364,20 +452,13 @@ def zigzag_ring_attention_kernel(q, k, v, axis: str,
     return jnp.transpose(jnp.concatenate(outs, axis=1), (1, 0, 2))
 
 
-def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
-                                       scale: float | None = None,
-                                       block_q: int = 512,
-                                       block_k: int = 512,
-                                       interpret: bool | None = None):
-    """Fused zigzag ring attention: the quadrant schedule of
-    ``zigzag_ring_attention_kernel`` with each computed quadrant running
-    as ONE Pallas flash hop (``flash_attention_hop`` on half-blocks, the
-    online-softmax carry flowing around the ring).  Cross quadrants use
-    the maskless kernel; diagonal quadrants the causal kernel with global
-    chunk offsets.  Forward-only (use ``zigzag_ring_attention_kernel``
-    for the differentiable path).
-    """
-    from ..ops.pallas_attention import flash_attention_hop, flash_carry_init
+def _zigzag_flash_fwd_loop(q, k, v, axis, scale, block_q, block_k,
+                           interpret):
+    """Shared fused-zigzag forward.  Returns ``(out (b,h,d), oh (h,b,d),
+    lse (h,b))`` with the two half-chunks concatenated on the row axis."""
+    from ..ops.pallas_attention import (flash_attention_hop,
+                                       flash_carry_finalize,
+                                       flash_carry_init)
 
     nblk = lax.axis_size(axis)
     me = lax.axis_index(axis)
@@ -441,12 +522,150 @@ def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
     c1, c2, kc, vc = lax.fori_loop(0, nblk - 1, body, (init, init, kh, vh))
     c1, c2 = accumulate(nblk - 1, c1, c2, kc, vc)
 
-    outs = []
-    for m, l, a in (c1, c2):
-        ln = l[:, :, :1]
-        ln = jnp.where(ln == 0.0, 1.0, ln)
-        outs.append((a / ln).astype(q.dtype))            # (h, half, dh)
-    return jnp.transpose(jnp.concatenate(outs, axis=1), (1, 0, 2))
+    oh1, lse1 = flash_carry_finalize(*c1, q.dtype)
+    oh2, lse2 = flash_carry_finalize(*c2, q.dtype)
+    oh = jnp.concatenate([oh1, oh2], axis=1)             # (h, b, dh)
+    lse = jnp.concatenate([lse1, lse2], axis=1)          # (h, b)
+    return jnp.transpose(oh, (1, 0, 2)), oh, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _zigzag_flash_core(q, k, v, axis, scale, block_q, block_k, interpret):
+    out, _, _ = _zigzag_flash_fwd_loop(q, k, v, axis, scale,
+                                       block_q, block_k, interpret)
+    return out
+
+
+def _zigzag_flash_core_fwd(q, k, v, axis, scale, block_q, block_k,
+                           interpret):
+    out, oh, lse = _zigzag_flash_fwd_loop(q, k, v, axis, scale,
+                                          block_q, block_k, interpret)
+    return out, (q, k, v, oh, lse)
+
+
+def _zigzag_flash_core_bwd(axis, scale, block_q, block_k, interpret, res, g):
+    # the ring FA2 backward (see _ring_flash_core_bwd) specialized to the
+    # zigzag quadrant schedule: each hop re-runs exactly the quadrants the
+    # forward computed (the same lax.switch on sign(src - me)), adding
+    # each quadrant's (dq, dk, dv) contribution — dq into the local half
+    # accumulators, dk/dv into the accumulators TRAVELING with the k/v
+    # halves around the ring.
+    from ..ops.pallas_attention import _LANE, flash_attention_hop_bwd
+
+    q, k, v, oh, lse = res
+    nblk = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, h, dh = q.shape
+    half = b // 2
+    sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
+
+    qh = jnp.transpose(q, (1, 0, 2))
+    kh = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    gf = jnp.transpose(g, (1, 0, 2)).astype(jnp.float32)   # (h, b, dh)
+    # dd from the FULL-precision cotangent (matches _flash_bwd); only the
+    # kernel operand gh is downcast to the MXU input dtype
+    dd = jnp.einsum("hbd,hbd->hb", gf, oh.astype(jnp.float32))
+    gh = gf.astype(q.dtype)
+    ddb = jnp.broadcast_to(dd[:, :, None], (h, b, _LANE))
+    lseb = jnp.broadcast_to(lse[:, :, None], (h, b, _LANE))
+    q1, q2 = qh[:, :half], qh[:, half:]
+    g1, g2 = gh[:, :half], gh[:, half:]
+    dd1, dd2 = ddb[:, :half], ddb[:, half:]
+    lse1, lse2 = lseb[:, :half], lseb[:, half:]
+    qoff1 = me * half
+    qoff2 = (2 * nblk - 1 - me) * half
+
+    def hb(causal_, qx, gx, lsex, ddx, qoff, kx, vx, koff):
+        return flash_attention_hop_bwd(
+            qx, kx, vx, gx, lsex, ddx, qoff, koff, causal=causal_, scale=sc,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+
+    def accumulate_bwd(step, dq1a, dq2a, dka, dva, kc, vc):
+        src = (me - step) % nblk
+        k1, v1 = kc[:, :half], vc[:, :half]
+        k2, v2 = kc[:, half:], vc[:, half:]
+        koff1 = src * half
+        koff2 = (2 * nblk - 1 - src) * half
+        # q2 x k1: always computed in the forward
+        dqc, dkc, dvc = hb(False, q2, g2, lse2, dd2, qoff2, k1, v1, koff1)
+        dq2a = dq2a + dqc
+        dka = dka.at[:, :half].add(dkc)
+        dva = dva.at[:, :half].add(dvc)
+
+        def lt(ops):
+            dq1a, dq2a, dka, dva = ops
+            dqc, dkc, dvc = hb(False, q1, g1, lse1, dd1, qoff1,
+                               k1, v1, koff1)
+            return (dq1a + dqc, dq2a, dka.at[:, :half].add(dkc),
+                    dva.at[:, :half].add(dvc))
+
+        def eq(ops):
+            dq1a, dq2a, dka, dva = ops
+            dqc1, dkc1, dvc1 = hb(True, q1, g1, lse1, dd1, qoff1,
+                                  k1, v1, koff1)
+            dqc2, dkc2, dvc2 = hb(True, q2, g2, lse2, dd2, qoff2,
+                                  k2, v2, koff2)
+            return (dq1a + dqc1, dq2a + dqc2,
+                    dka.at[:, :half].add(dkc1).at[:, half:].add(dkc2),
+                    dva.at[:, :half].add(dvc1).at[:, half:].add(dvc2))
+
+        def gt(ops):
+            dq1a, dq2a, dka, dva = ops
+            dqc, dkc, dvc = hb(False, q2, g2, lse2, dd2, qoff2,
+                               k2, v2, koff2)
+            return (dq1a, dq2a + dqc, dka.at[:, half:].add(dkc),
+                    dva.at[:, half:].add(dvc))
+
+        idx = jnp.clip(jnp.sign(src - me) + 1, 0, 2).astype(jnp.int32)
+        return lax.switch(idx, (lt, eq, gt), (dq1a, dq2a, dka, dva))
+
+    perm = [(i, (i + 1) % nblk) for i in range(nblk)]
+    zh = lambda: jnp.zeros((h, half, dh), jnp.float32)
+    zb = lambda: jnp.zeros((h, b, dh), jnp.float32)
+
+    def body(step, carry):
+        dq1a, dq2a, dka, dva, kc, vc = carry
+        dq1a, dq2a, dka, dva = accumulate_bwd(step, dq1a, dq2a, dka, dva,
+                                              kc, vc)
+        kc = lax.ppermute(kc, axis, perm)
+        vc = lax.ppermute(vc, axis, perm)
+        dka = lax.ppermute(dka, axis, perm)
+        dva = lax.ppermute(dva, axis, perm)
+        return dq1a, dq2a, dka, dva, kc, vc
+
+    dq1a, dq2a, dka, dva, kc, vc = lax.fori_loop(
+        0, nblk - 1, body, (zh(), zh(), zb(), zb(), kh, vh))
+    dq1a, dq2a, dka, dva = accumulate_bwd(nblk - 1, dq1a, dq2a, dka, dva,
+                                          kc, vc)
+    # block r's dk/dv sits one rank behind home after nblk-1 rotations
+    dka = lax.ppermute(dka, axis, perm)
+    dva = lax.ppermute(dva, axis, perm)
+    dq = jnp.concatenate([dq1a, dq2a], axis=1)
+    back = lambda t: jnp.transpose(t, (1, 0, 2)).astype(q.dtype)
+    return back(dq), back(dka), back(dva)
+
+
+_zigzag_flash_core.defvjp(_zigzag_flash_core_fwd, _zigzag_flash_core_bwd)
+
+
+def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
+                                       scale: float | None = None,
+                                       block_q: int = 512,
+                                       block_k: int = 512,
+                                       interpret: bool | None = None):
+    """Fused zigzag ring attention: the quadrant schedule of
+    ``zigzag_ring_attention_kernel`` with each computed quadrant running
+    as ONE Pallas flash hop (``flash_attention_hop`` on half-blocks, the
+    online-softmax carry flowing around the ring).  Cross quadrants use
+    the maskless kernel; diagonal quadrants the causal kernel with global
+    chunk offsets.  DIFFERENTIABLE end to end (custom_vjp): the backward
+    re-runs the quadrant schedule with the FA2 recompute kernels, so
+    load-balanced causal training also runs at Pallas speed.
+    """
+    sc = None if scale is None else float(scale)
+    return _zigzag_flash_core(q, k, v, axis, sc, int(block_q),
+                              int(block_k), interpret)
 
 
 @functools.lru_cache(maxsize=32)

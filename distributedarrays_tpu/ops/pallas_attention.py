@@ -41,7 +41,9 @@ except Exception:  # pragma: no cover
 
 from .pallas_gemm import _on_tpu
 
-__all__ = ["flash_attention", "flash_block_size", "flash_carry_init"]
+__all__ = ["flash_attention", "flash_block_size", "flash_attention_hop",
+           "flash_attention_hop_bwd", "flash_carry_init",
+           "flash_carry_finalize"]
 
 # Per-row softmax stats (running max / normalizer / logsumexp) are stored
 # broadcast across one 128-wide lane register: TPU lowering requires the
@@ -162,8 +164,8 @@ def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
-                   acc_ref, *, scale, causal, bq, bk, k_steps):
+def _bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   dd_ref, dq_ref, acc_ref, *, scale, causal, bq, bk, k_steps):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -171,7 +173,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    live = (ki * bk <= qi * bq + bq - 1) if causal else (ki == ki)
+    # global offsets arrive as SMEM scalars (0 single-chip; the block's ring
+    # position per hop), so causality is judged in GLOBAL sequence positions
+    if causal:
+        live = (koff_ref[0] + ki * bk <= qoff_ref[0] + qi * bq + bq - 1)
+    else:
+        live = ki == ki
 
     @pl.when(live)
     def _accumulate():
@@ -186,8 +193,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            qpos = qoff_ref[0] + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kpos = koff_ref[0] + ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(kpos <= qpos, s, -jnp.inf)
         p = jnp.exp(s - lse)                               # exact probs
         p = jnp.where(jnp.isfinite(s), p, 0.0)
@@ -203,8 +212,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
-                    dk_ref, dv_ref, acck_ref, accv_ref, *,
+def _bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    dd_ref, dk_ref, dv_ref, acck_ref, accv_ref, *,
                     scale, causal, bq, bk, q_steps):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -214,8 +223,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         acck_ref[:] = jnp.zeros_like(acck_ref)
         accv_ref[:] = jnp.zeros_like(accv_ref)
 
-    # causal: a q block strictly above the k block sees none of it
-    live = (qi * bq + bq - 1 >= ki * bk) if causal else (qi == qi)
+    # causal: a q block strictly above the k block (in GLOBAL positions —
+    # see _bwd_dq_kernel on the SMEM offsets) sees none of it
+    if causal:
+        live = (qoff_ref[0] + qi * bq + bq - 1 >= koff_ref[0] + ki * bk)
+    else:
+        live = qi == qi
 
     @pl.when(live)
     def _accumulate():
@@ -230,8 +243,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            qpos = qoff_ref[0] + qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kpos = koff_ref[0] + ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(kpos <= qpos, s, -jnp.inf)
         p = jnp.exp(s - lse)
         p = jnp.where(jnp.isfinite(s), p, 0.0)             # (bq, bk)
@@ -254,10 +269,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 
 @functools.lru_cache(maxsize=64)
-def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
+def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret,
+               out_dtype_str=None):
     if pltpu is None:
         raise RuntimeError("pallas TPU namespace unavailable")
-    dtype = jnp.dtype(dtype_str)
+    out_dtype = jnp.dtype(out_dtype_str or dtype_str)
     k_steps, q_steps = s // bk, s // bq
 
     dq_call = pl.pallas_call(
@@ -265,6 +281,8 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
                           bq=bq, bk=bk, k_steps=k_steps),
         grid=(h, q_steps, k_steps),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # qoff
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # koff
             pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # v
@@ -273,7 +291,7 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
             pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, s, d), dtype),
+        out_shape=jax.ShapeDtypeStruct((h, s, d), out_dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )
@@ -283,6 +301,8 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
                           bq=bq, bk=bk, q_steps=q_steps),
         grid=(h, k_steps, q_steps),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # qoff
+            pl.BlockSpec(memory_space=pltpu.SMEM),                     # koff
             pl.BlockSpec((1, bq, d), lambda hh, ki, qi: (hh, qi, 0)),  # q
             pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),  # k
             pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),  # v
@@ -295,8 +315,8 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
             pl.BlockSpec((1, bk, d), lambda hh, ki, qi: (hh, ki, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((h, s, d), dtype),
-            jax.ShapeDtypeStruct((h, s, d), dtype),
+            jax.ShapeDtypeStruct((h, s, d), out_dtype),
+            jax.ShapeDtypeStruct((h, s, d), out_dtype),
         ),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
@@ -442,6 +462,53 @@ def flash_carry_init(h: int, b: int, d: int):
             jnp.zeros((h, b, d), jnp.float32))
 
 
+def flash_carry_finalize(m, l, acc, dtype):
+    """Turn a final ``flash_attention_hop`` carry into (out, lse):
+    ``out = acc / l`` in ``dtype`` (h, b, d) and the per-row logsumexp
+    (h, b) f32 the FA2 backward consumes.  All-masked rows (l == 0)
+    produce out = 0, lse = 0 — with causal ring layouts every row attends
+    at least its own diagonal, so this case never carries gradients."""
+    ln = l[:, :, :1]
+    ln_safe = jnp.where(ln == 0.0, 1.0, ln)
+    out = (acc / ln_safe).astype(dtype)
+    m1, l1 = m[:, :, 0], l[:, :, 0]
+    m_fin = jnp.where(jnp.isfinite(m1), m1, 0.0)
+    lse = m_fin + jnp.log(jnp.where(l1 == 0.0, 1.0, l1))
+    return out, lse
+
+
+def flash_attention_hop_bwd(q, k, v, do, lse, dd, qoff, koff,
+                            causal: bool = False, scale: float | None = None,
+                            block_q: int = 512, block_k: int = 512,
+                            interpret: bool | None = None):
+    """Backward of ONE ring hop: the FA2 recompute pass restricted to the
+    (local q block) x (resident k/v block) tile pair.
+
+    Because ``p = exp(s - lse)`` is exact given the FINAL logsumexp, each
+    hop's gradient contribution is independent and additive: the ring
+    backward sums dq contributions locally and circulates dk/dv
+    accumulators around the ``ppermute`` ring with their k/v blocks.
+
+    q/k/v/do: ``(H, B, D)``; lse/dd: lane-broadcast ``(H, B, _LANE)`` f32
+    (final logsumexp rows and ``D_i = rowsum(dO * O)``); qoff/koff: global
+    sequence offsets (traced scalars).  Returns f32 ``(dq, dk, dv)``
+    CONTRIBUTIONS for this tile pair — callers accumulate.
+    """
+    H, B, D = q.shape
+    bq, bk = _fit_block(block_q, B), _fit_block(block_k, B)
+    if interpret is None:
+        interpret = not _on_tpu()
+    sc = float(1.0 / np.sqrt(D) if scale is None else scale)
+    dq_call, dkv_call = _build_bwd(H, B, D, bq, bk, str(q.dtype), sc,
+                                   bool(causal), bool(interpret),
+                                   out_dtype_str="float32")
+    qo = jnp.asarray(qoff, jnp.int32).reshape(1)
+    ko = jnp.asarray(koff, jnp.int32).reshape(1)
+    dq = dq_call(qo, ko, q, k, v, do, lse, dd)
+    dk, dv = dkv_call(qo, ko, q, k, v, do, lse, dd)
+    return dq, dk, dv
+
+
 def _dense_attention_shd(q, k, v, causal: bool, scale: float):
     """Dense jnp attention with EXACTLY the kernel's semantics (f32 softmax,
     (S, H, D) layout) — used as the differentiation rule for the kernel."""
@@ -493,8 +560,9 @@ def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
     lse = jnp.broadcast_to(lse[:, :, None], (H, S, _LANE))
     dq_call, dkv_call = _build_bwd(H, S, D, bq, bk, str(q.dtype), scale,
                                    causal, interpret)
-    dq = dq_call(qh, kh, vh, doh, lse, dd)
-    dk, dv = dkv_call(qh, kh, vh, doh, lse, dd)
+    zero = jnp.zeros((1,), jnp.int32)                 # single-chip: offsets 0
+    dq = dq_call(zero, zero, qh, kh, vh, doh, lse, dd)
+    dk, dv = dkv_call(zero, zero, qh, kh, vh, doh, lse, dd)
     back = lambda t: jnp.transpose(t, (1, 0, 2)).astype(q.dtype)
     return back(dq), back(dk), back(dv)
 

@@ -240,6 +240,100 @@ def test_zigzag_ring_differentiable(rng):
                                rtol=5e-3, atol=5e-4)
 
 
+def test_zigzag_ring_flash_matches_dense(rng):
+    # fused (Pallas per-quadrant) zigzag forward vs the dense oracle —
+    # interpret mode on the CPU mesh (ADVICE round-2 item 1)
+    from distributedarrays_tpu.models.ring_attention import (
+        zigzag_ring_flash_attention, zigzag_shard, zigzag_unshard,
+        reference_attention)
+    S, H, D = 64, 2, 16
+    q = rng.standard_normal((S, H, D)).astype(np.float32)
+    k = rng.standard_normal((S, H, D)).astype(np.float32)
+    v = rng.standard_normal((S, H, D)).astype(np.float32)
+    n = 8
+    dq = dat.distribute(np.asarray(zigzag_shard(q, n)),
+                        procs=range(n), dist=(n, 1, 1))
+    dk = dat.distribute(np.asarray(zigzag_shard(k, n)),
+                        procs=range(n), dist=(n, 1, 1))
+    dv = dat.distribute(np.asarray(zigzag_shard(v, n)),
+                        procs=range(n), dist=(n, 1, 1))
+    zz = zigzag_ring_flash_attention(dq, dk, dv)
+    got = np.asarray(zigzag_unshard(np.asarray(zz), n))
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# differentiable fused ring attention (VERDICT round-3 item 3): gradients
+# of the Pallas ring path vs the dense formulation, causal and full
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_differentiable(rng, causal):
+    from jax.sharding import PartitionSpec as RP
+    from distributedarrays_tpu import layout as L
+    from distributedarrays_tpu.ops.pallas_attention import (
+        _dense_attention_shd)
+
+    S, H, D, n = 64, 2, 16, 8
+    q = jnp.asarray(rng.standard_normal((S, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((S, H, D)).astype(np.float32))
+    mesh = L.mesh_for(range(n), (n, 1, 1))
+    ax = mesh.axis_names[0]
+    shm = jax.shard_map(
+        lambda a, b, c: RA.ring_flash_attention_kernel(a, b, c, ax,
+                                                       causal=causal),
+        mesh=mesh, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
+        check_vma=False)
+    g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(shm(a, b, c) ** 2),
+                         (0, 1, 2)))(q, k, v)
+    scale = float(1.0 / np.sqrt(D))
+    gd = jax.grad(lambda a, b, c: jnp.sum(
+        _dense_attention_shd(a, b, c, causal, scale) ** 2), (0, 1, 2))(q, k, v)
+    for got, want in zip(g, gd):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_zigzag_ring_flash_differentiable(rng):
+    from jax.sharding import PartitionSpec as RP
+    from distributedarrays_tpu import layout as L
+    from distributedarrays_tpu.models.ring_attention import (
+        zigzag_ring_flash_attention_kernel, zigzag_shard)
+    from distributedarrays_tpu.ops.pallas_attention import (
+        _dense_attention_shd)
+
+    S, H, D, n = 64, 2, 16, 4
+    q = rng.standard_normal((S, H, D)).astype(np.float32)
+    k = rng.standard_normal((S, H, D)).astype(np.float32)
+    v = rng.standard_normal((S, H, D)).astype(np.float32)
+    mesh = L.mesh_for(list(range(n)), (n, 1, 1))
+    ax = mesh.axis_names[0]
+    shm = jax.shard_map(
+        lambda a, b, c: zigzag_ring_flash_attention_kernel(a, b, c, ax),
+        mesh=mesh, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
+        check_vma=False)
+
+    # loss over the fused zigzag path, differentiating through the
+    # zigzag reorder so gradients land in NATURAL order for the oracle
+    def loss(a, b, c):
+        az, bz, cz = (zigzag_shard(x, n) for x in (a, b, c))
+        return jnp.sum(shm(az, bz, cz).astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss, (0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    scale = float(1.0 / np.sqrt(D))
+    gd = jax.grad(lambda a, b, c: jnp.sum(
+        _dense_attention_shd(a, b, c, True, scale) ** 2), (0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for got, want in zip(g, gd):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-3, atol=1e-4)
+
+
 def test_zigzag_validation(rng):
     from distributedarrays_tpu.models.ring_attention import (
         zigzag_ring_attention)
