@@ -14,16 +14,28 @@ a strictly-stronger stand-in for the reference's "4 CPU workers" config
 (the reference's Julia Distributed GEMM over 4 local TCP workers cannot
 beat the host's full BLAS).
 
-Methodology: this environment reaches the TPU through a remote tunnel with
-~tens-of-ms per-dispatch latency, so per-call wall timing measures the
-tunnel, not the chip.  Each config is therefore timed as the *marginal*
-cost inside one compiled program: run L iterations and 1 iteration of the
-op chained in a ``lax.scan`` (data-dependent so XLA cannot hoist or elide),
-force completion with a scalar fetch, and divide the difference.  Eager
-per-call latencies are recorded alongside in BENCH_DETAILS.json.
+Methodology (round-3 revision).  This environment reaches the TPU through
+a remote tunnel: per-dispatch latency is tens of ms and
+``block_until_ready`` does NOT synchronize through it, so every timing
+must chain L iterations of the op inside ONE compiled ``lax.scan``
+(data-dependent so XLA cannot hoist or elide) and force completion with a
+scalar fetch.  Round 2 derived per-iteration cost as the MARGINAL
+difference t(L+1) - t(1); that subtraction can under-estimate when the
+two measurements catch different tunnel states, and it produced one
+physically impossible number (213.9 TFLOPS bf16 on a ~197-peak chip,
+VERDICT round-2).  The BANKED numbers now come from DIRECT timing —
+``t(L) / L`` with L grown until one call takes >= ~1.2 s — which is
+bounded by physics: one call's wall time >= the device compute it
+contains, so derived TFLOPS cannot exceed the chip's peak.  The marginal
+estimate is still recorded per entry as a cross-check diagnostic, and
+every TFLOPS entry carries its MFU against the chip's known bf16 peak;
+any entry above peak is flagged in ``_impossible`` (and would indicate a
+methodology bug, not a fast chip).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -32,6 +44,8 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
+_HEADLINE_METRIC = "gemm_4096_gflops_mixed_precision_bf16pass"
+
 
 def _t(fn):
     t0 = time.perf_counter()
@@ -39,9 +53,24 @@ def _t(fn):
     return time.perf_counter() - t0
 
 
+def _periter(run_for_length, L0=8, target_s=1.2, max_L=4096):
+    """Direct per-iteration cost: grow L until ONE compiled scan-chain call
+    takes >= ``target_s`` (so dispatch latency is amortized below ~5%),
+    then return ``(t(L)/L, L)``.  Each new L costs a compile, so L grows
+    in as few steps as possible (estimate from the last timing).
+    Physically bounded: wall time of one call >= its device compute."""
+    L = L0
+    while True:
+        t = run_for_length(L)
+        if t >= target_s or L >= max_L:
+            return t / L, L
+        est = max(t / L, 1e-7)                  # upper bound incl. dispatch
+        L = min(max_L, max(L * 2, int(1.4 * target_s / est) + 1))
+
+
 def _marginal(run_for_length, L0=10, min_delta=0.05, max_L=1000):
-    """Marginal per-iteration cost: time(L iters) - time(1 iter), growing L
-    until the delta clears the tunnel-latency noise floor."""
+    """Marginal per-iteration cost t(L+1)-t(1) / L — round-2 methodology,
+    kept ONLY as a cross-check diagnostic (see module docstring)."""
     t1 = run_for_length(1)
     L = L0
     while True:
@@ -52,12 +81,37 @@ def _marginal(run_for_length, L0=10, min_delta=0.05, max_L=1000):
         L *= 4
 
 
+# Dense bf16 peak TFLOPS per chip, for MFU and impossibility flags.
+# Sources: public TPU spec sheets (v5e 197, v4 275, v5p 459, v6e 918).
+_PEAKS_BF16 = [("v6 lite", 918.0), ("v6e", 918.0), ("v5 lite", 197.0),
+               ("v5e", 197.0), ("v5p", 459.0), ("v5", 459.0),
+               ("v4", 275.0), ("v3", 123.0), ("v2", 45.0)]
+
+
+def _chip_peak_tflops(device_kind: str):
+    dk = device_kind.lower()
+    for frag, peak in _PEAKS_BF16:
+        if frag in dk:
+            return peak
+    return None
+
+
+def _bank_tflops(details, name, tflops, peak):
+    """Record a TFLOPS entry with its MFU; flag physically impossible
+    values instead of publishing them silently.  The flag is a per-entry
+    key (not a shared list) so configs merged via ``details.update``
+    cannot clobber each other's flags."""
+    details[name + "_tflops"] = tflops
+    if peak:
+        details[name + "_mfu"] = round(tflops / peak, 4)
+        if tflops > peak:
+            details[name + "_IMPOSSIBLE_above_peak"] = True
+
+
 def _run_with_timeout(fn, timeout_s: float, grace_s: float = 0.0):
     """Run ``fn`` on a daemon thread with a hard timeout (a wedged remote
     tunnel hangs forever instead of erroring).  Returns ``(finished,
-    value_or_exception, thread)``; on timeout the thread is abandoned
-    after an optional ``grace_s`` extra join (callers can use the thread
-    handle to detect an orphan still dispatching device work)."""
+    value_or_exception, thread)``."""
     import threading
 
     box = {}
@@ -80,22 +134,48 @@ def _run_with_timeout(fn, timeout_s: float, grace_s: float = 0.0):
     return True, box.get("value"), t
 
 
-def _device_watchdog(timeout_s: float = 480.0):
-    """Probe the accelerator with a tiny op under a hard timeout."""
-    def probe():
-        import jax.numpy as jnp
-        return float(jnp.sum(jnp.ones((8, 8))))
+# DAT_BENCH_PLATFORM=cpu runs the whole harness on host CPU — for testing
+# the harness logic itself (this image's sitecustomize pre-sets
+# jax_platforms, so the env var alone is not enough; the config API is).
+_PLATFORM = os.environ.get("DAT_BENCH_PLATFORM")
 
-    finished, v, _ = _run_with_timeout(probe, timeout_s)
-    if not finished:
-        return {"ok": False, "error": f"device probe timed out after "
-                                      f"{timeout_s:.0f}s (wedged tunnel?)"}
-    if isinstance(v, Exception):
-        return {"ok": False,
-                "error": f"device probe raised: {type(v).__name__}: {v}"}
-    if v != 64.0:
-        return {"ok": False, "error": f"device probe returned {v}, expected 64.0"}
-    return {"ok": True}
+_FORCE = (f"import jax; jax.config.update('jax_platforms', {_PLATFORM!r}); "
+          if _PLATFORM else "")
+_PROBE_CODE = (_FORCE +
+               "import jax, jax.numpy as jnp; "
+               "print('PROBE_OK', float(jnp.sum(jnp.ones((8, 8)))), "
+               "[str(d) for d in jax.devices()])")
+
+
+def _probe_with_retry(budget_s: float = 900.0):
+    """Probe the accelerator in FRESH SUBPROCESSES with growing timeouts
+    and backoff: the observed wedges are transient (VERDICT round-3 item
+    1), and a wedged attempt must not poison this process's runtime.
+    Returns {"ok": True, "attempts": n} or {"ok": False, "error": ...}."""
+    t0 = time.monotonic()
+    schedule = [90, 120, 180, 240, 300, 300, 300]
+    errors = []
+    for i, tmo in enumerate(schedule):
+        left = budget_s - (time.monotonic() - t0)
+        if left < 45:
+            break
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                capture_output=True, text=True, timeout=min(tmo, left),
+                env={**os.environ, "PYTHONWARNINGS": "ignore"})
+            if "PROBE_OK 64.0" in r.stdout:
+                return {"ok": True, "attempts": i + 1,
+                        "probe_s": time.monotonic() - t0}
+            errors.append(f"attempt {i+1}: rc={r.returncode} "
+                          f"{(r.stderr or r.stdout)[-200:]!r}")
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {i+1}: timed out after {tmo:.0f}s")
+        time.sleep(25)
+    return {"ok": False,
+            "error": f"accelerator unreachable after {len(errors)} attempts "
+                     f"over {time.monotonic() - t0:.0f}s: "
+                     + " | ".join(errors[-3:])}
 
 
 def _save(details):
@@ -104,7 +184,8 @@ def _save(details):
 
 
 _START = time.monotonic()
-_GLOBAL_BUDGET_S = 3000.0   # leave headroom under the driver's own timeout
+# headroom under the driver's own timeout; env override for harness tests
+_GLOBAL_BUDGET_S = float(os.environ.get("DAT_BENCH_BUDGET_S", "3300"))
 
 
 def _guarded(details, label, fn, timeout_s=420.0):
@@ -125,16 +206,12 @@ def _guarded(details, label, fn, timeout_s=420.0):
     if finished and isinstance(res, Exception) and \
             "remote_compile" in str(res) and _remaining() > 75:
         # transient tunnel-service flake (observed: response body closed
-        # mid-read); one retry after a settle pause, against the budget
-        # actually left now
+        # mid-read); one retry after a settle pause
         time.sleep(15)
         effective = min(timeout_s, _remaining())
         finished, res, thread = _run_with_timeout(fn, effective)
     if not finished:
         details[f"{label}_error"] = f"timed out after {effective:.0f}s"
-        # the abandoned thread may still be dispatching device work; give
-        # it a bounded drain so it cannot pollute the NEXT config's
-        # timings, and flag it if it outlives the grace
         thread.join(60)
         if thread.is_alive():
             details[f"{label}_orphan_running"] = True
@@ -146,16 +223,18 @@ def _guarded(details, label, fn, timeout_s=420.0):
 
 
 def main():
-    probe = _device_watchdog()
+    probe = _probe_with_retry()
     if not probe["ok"]:
         print(json.dumps({
-            "metric": "gemm_4096_gflops_mixed_precision_bf16pass",
+            "metric": _HEADLINE_METRIC,
             "value": 0.0, "unit": "GFLOPS", "vs_baseline": 0.0,
-            "error": f"accelerator unreachable ({probe['error']})",
+            "error": probe["error"],
         }))
         return
 
     import jax
+    if _PLATFORM:
+        jax.config.update("jax_platforms", _PLATFORM)
     import jax.numpy as jnp
     from jax import lax
     import distributedarrays_tpu as dat
@@ -170,17 +249,46 @@ def main():
         import shutil
         shutil.copyfile(cur, cur.with_name("BENCH_DETAILS_prev.json"))
 
-    ndev = len(jax.devices())
-    details = {"devices": [str(d) for d in jax.devices()]}
+    # device init in THIS process can still wedge even after a subprocess
+    # probe succeeded — bounded, with one retry
+    for attempt in range(2):
+        finished, devs, _ = _run_with_timeout(jax.devices, 300)
+        if finished and not isinstance(devs, Exception):
+            break
+        time.sleep(20)
+    else:
+        print(json.dumps({
+            "metric": _HEADLINE_METRIC,
+            "value": 0.0, "unit": "GFLOPS", "vs_baseline": 0.0,
+            "error": "probe subprocess succeeded but in-process device "
+                     "init wedged twice",
+        }))
+        return
 
-    # ---- config 0: 4096^2 f32 GEMM ---------------------------------------
+    ndev = len(devs)
+    peak = _chip_peak_tflops(devs[0].device_kind)
+    details = {
+        "_provenance": {
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "platform_override": _PLATFORM,
+            "devices": [str(d) for d in devs],
+            "device_kind": devs[0].device_kind,
+            "bf16_peak_tflops": peak,
+            "method": "direct t(L)/L over one compiled lax.scan chain, "
+                      "scalar-fetch forced; marginal t(L+1)-t(1) recorded "
+                      "as *_marginal_crosscheck_s diagnostics only",
+            "probe_attempts": probe.get("attempts"),
+        },
+    }
+
+    # ---- config 0 (headline): 4096^2 GEMM, DEFAULT precision ------------
     N = 4096
     dat.seed(7)
     A = dat.drand((N, N), dtype=jnp.float32)
     B = dat.drand((N, N), dtype=jnp.float32)
     scale = jnp.float32(1.0 / N)
 
-    def gemm_chain_at(precision):
+    def gemm_chain_at(precision, reps=2):
         def gemm_chain(L):
             @dat.djit
             def f(a, b):
@@ -189,27 +297,20 @@ def main():
                 c, _ = lax.scan(body, a, None, length=L)
                 return jnp.sum(c)
             float(f(A, B))                  # compile + warmup
-            return min(_t(lambda: float(f(A, B))) for _ in range(3))
+            return min(_t(lambda: float(f(A, B))) for _ in range(reps))
         return gemm_chain
 
-    # headline: DEFAULT precision (the TPU-native mixed bf16-pass matmul,
-    # labeled as such).  A previous session observed the remote-compile
-    # service wedge while compiling a HIGHEST-precision scan, so the true-
-    # f32 measurement is attempted LAST (see end of main) under a timeout,
-    # after every other number is already banked.
-    t_gemm = _marginal(gemm_chain_at(jax.lax.Precision.DEFAULT), L0=50)
+    chain = gemm_chain_at(jax.lax.Precision.DEFAULT)
+    t_gemm, L_used = _periter(chain, L0=64)
     gflops = 2 * N**3 / t_gemm / 1e9
-    details["gemm_4096_mixed_bf16pass_marginal_s"] = t_gemm
+    details["gemm_4096_mixed_bf16pass_s_per_iter"] = t_gemm
+    details["gemm_4096_mixed_bf16pass_L"] = L_used
     details["gemm_4096_mixed_bf16pass_gflops"] = gflops
+    _bank_tflops(details, "gemm_4096_mixed_bf16pass", gflops / 1e3, peak)
     (A @ B).garray                         # compile the eager path
     details["gemm_4096_mixed_bf16pass_eager_latency_s"] = _t(
         lambda: (A @ B).garray)
     _save(details)
-
-    # sum(A.^2) half of config 0
-    float(dat.dmapreduce(jnp.square, "sum", A))
-    t_sum = _t(lambda: float(dat.dmapreduce(jnp.square, "sum", A)))
-    details["sum_sq_4096_eager_s"] = t_sum
 
     # ---- CPU baseline: same GEMM in numpy (host BLAS) --------------------
     an = np.asarray(A, dtype=np.float32)
@@ -223,11 +324,29 @@ def main():
     # tunnel wedge in a later config must not cost the round its one JSON
     # line (round-1 lesson; this run prints exactly this one line)
     print(json.dumps({
-        "metric": "gemm_4096_gflops_mixed_precision_bf16pass",
+        "metric": _HEADLINE_METRIC,
         "value": round(gflops, 2),
         "unit": "GFLOPS",
         "vs_baseline": round(gflops / cpu_gflops, 2),
     }), flush=True)
+
+    # sum(A.^2) half of config 0 (after the headline: banked detail only)
+    float(dat.dmapreduce(jnp.square, "sum", A))
+    details["sum_sq_4096_eager_s"] = _t(
+        lambda: float(dat.dmapreduce(jnp.square, "sum", A)))
+    _save(details)
+
+    # methodology cross-check on the SAME op: the round-2 marginal
+    # estimator vs the banked direct number (agreement ratio recorded; a
+    # marginal-derived TFLOPS above peak proves the estimator, not the
+    # chip)
+    def cfg_crosscheck():
+        t_m = _marginal(chain, L0=50)
+        out = {"gemm_4096_marginal_crosscheck_s": t_m,
+               "gemm_4096_marginal_vs_direct_ratio": t_m / t_gemm}
+        return out
+
+    _guarded(details, "gemm_crosscheck", cfg_crosscheck, timeout_s=300)
 
     # ---- config 1: broadcast chain sin.(A) .+ B .* C on 8192^2 ----------
     M = 8192
@@ -241,11 +360,11 @@ def main():
             acc, _ = lax.scan(body, a, None, length=L)
             return jnp.sum(acc)
         float(f(X, Y, Z))
-        return min(_t(lambda: float(f(X, Y, Z))) for _ in range(3))
+        return min(_t(lambda: float(f(X, Y, Z))) for _ in range(2))
 
     def cfg_chain():
-        t_chain = _marginal(chain_chain, L0=20)
-        return {"broadcast_chain_8192_marginal_s": t_chain,
+        t_chain, L = _periter(chain_chain, L0=32)
+        return {"broadcast_chain_8192_s_per_iter": t_chain,
                 "broadcast_chain_8192_gbps": 4 * M * M * 4 / t_chain / 1e9}
 
     _guarded(details, "broadcast_chain", cfg_chain)
@@ -262,11 +381,11 @@ def main():
             acc, _ = lax.scan(body, jnp.float32(0), None, length=L)
             return acc
         float(f(V))
-        return min(_t(lambda: float(f(V))) for _ in range(3))
+        return min(_t(lambda: float(f(V))) for _ in range(2))
 
     def cfg_mr():
-        t_mr = _marginal(mr_chain, L0=40)
-        out = {"mapreduce_1e8_marginal_s": t_mr,
+        t_mr, L = _periter(mr_chain, L0=64)
+        out = {"mapreduce_1e8_s_per_iter": t_mr,
                "mapreduce_1e8_gbps": 4 * 1e8 / t_mr / 1e9}
         float(dat.dmean(V)); float(dat.dstd(V))
         out["mean_std_1e8_eager_s"] = _t(
@@ -297,17 +416,17 @@ def main():
     # halo exchange per step), the jnp formulation for comparison, and the
     # temporal-blocked kernel (k=8 steps per launch, ghost-zone scheme)
     def cfg_stencil():
-        t_st = _marginal(st_len_at(None, temporal=1), L0=10)
-        return {"stencil_8192_step_marginal_s": t_st,
+        t_st, L = _periter(st_len_at(None, temporal=1), L0=16)
+        return {"stencil_8192_step_s_per_iter": t_st,
                 "stencil_8192_gcells_per_s": rows * M / t_st / 1e9}
 
     def cfg_stencil_jnp():
-        t_stj = _marginal(st_len_at(False), L0=10)
+        t_stj, L = _periter(st_len_at(False), L0=16)
         return {"stencil_8192_jnp_gcells_per_s": rows * M / t_stj / 1e9}
 
     def cfg_stencil_temporal():
-        t_stt = _marginal(st_len_at(None), L0=16)    # auto temporal depth
-        return {"stencil_8192_temporal_marginal_s": t_stt,
+        t_stt, L = _periter(st_len_at(None), L0=32)  # auto temporal depth
+        return {"stencil_8192_temporal_s_per_iter": t_stt,
                 "stencil_8192_temporal_gcells_per_s": rows * M / t_stt / 1e9}
 
     _guarded(details, "stencil", cfg_stencil)
@@ -347,10 +466,12 @@ def main():
         return gemm16_chain
 
     def cfg_gemm16():
-        t16 = _marginal(gemm16_chain_at(jax.lax.Precision.DEFAULT),
-                        L0=5, min_delta=0.1)
-        return {f"{tag}_bf16pass_marginal_s": t16,
-                f"{tag}_bf16pass_gflops": 2 * K16**3 / t16 / 1e9}
+        t16, L = _periter(gemm16_chain_at(jax.lax.Precision.DEFAULT), L0=2)
+        g = 2 * K16**3 / t16 / 1e9
+        out = {f"{tag}_bf16pass_s_per_iter": t16,
+               f"{tag}_bf16pass_gflops": g}
+        _bank_tflops(out, f"{tag}_bf16pass", g / 1e3, peak)
+        return out
 
     _guarded(details, tag, cfg_gemm16, timeout_s=600)
 
@@ -364,7 +485,6 @@ def main():
             def f():
                 def body(x, _):
                     # 1024^2 blocks: the measured-best tiling on v5e
-                    # (52 TFLOPS causal vs 2.7 at 128^2)
                     return flash_attention(x, q, q, causal=True,
                                            block_q=1024, block_k=1024), None
                 x, _ = lax.scan(body, q, None, length=L)
@@ -373,11 +493,13 @@ def main():
             float(jf())
             return min(_t(lambda: float(jf())) for _ in range(2))
 
-        t_fa = _marginal(fa_len, L0=4, min_delta=0.05)
+        t_fa, L = _periter(fa_len, L0=8)
         # causal flash: ~2*S^2*D*H flops (QK^T + PV), halved by causality
         flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
-        return {"flash_attn_8k_bf16_marginal_s": t_fa,
-                "flash_attn_8k_bf16_tflops": flops / t_fa / 1e12}
+        out = {"flash_attn_8k_bf16_s_per_iter": t_fa}
+        _bank_tflops(out, "flash_attn_8k_bf16_causal_effective",
+                     flops / t_fa / 1e12, peak)
+        return out
 
     _guarded(details, "flash_attn", cfg_flash)
 
@@ -404,7 +526,9 @@ def main():
                 jf = jax.jit(f)
                 float(jf())
                 return min(_t(lambda: float(jf())) for _ in range(2))
-            return _marginal(fa_len, L0=4, min_delta=0.05)
+            # sweep arms use a shorter target: ranking needs less
+            # precision than banking, and there are many arms
+            return _periter(fa_len, L0=8, target_s=0.6)[0]
 
         cands = [(bq, bk) for bq in (512, 1024, 2048)
                  for bk in (512, 1024, 2048)]
@@ -412,15 +536,55 @@ def main():
         best, results = autotune.sweep("flash_attention", key, cands, timer)
         cache = autotune.save_default()   # future processes pick this up
         flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
-        return {
+        out = {
             "flash_attn_tuned_block": list(best),
-            "flash_attn_tuned_tflops": flops / results[best] / 1e12,
             "flash_attn_sweep": {f"{bq}x{bk}": flops / t / 1e12
                                  for (bq, bk), t in results.items()},
             "autotune_cache_path": cache,
         }
+        _bank_tflops(out, "flash_attn_tuned_causal_effective",
+                     flops / results[best] / 1e12, peak)
+        return out
 
     _guarded(details, "flash_attn_tune", cfg_flash_tune, timeout_s=600)
+
+    # ---- extra: non-causal flash MFU (VERDICT round-3 item 5) ------------
+    def cfg_flash_full():
+        from distributedarrays_tpu.ops.pallas_attention import flash_attention
+        from distributedarrays_tpu.utils import autotune
+        SQ, HQ, DQ = 8192, 8, 64
+        q = jax.random.normal(jax.random.key(1), (SQ, HQ, DQ), jnp.bfloat16)
+
+        def timer(cfg):
+            bq, bk = cfg
+
+            def fa_len(L):
+                def f():
+                    def body(x, _):
+                        return flash_attention(x, q, q, causal=False,
+                                               block_q=bq, block_k=bk), None
+                    x, _ = lax.scan(body, q, None, length=L)
+                    return jnp.sum(x.astype(jnp.float32))
+                jf = jax.jit(f)
+                float(jf())
+                return min(_t(lambda: float(jf())) for _ in range(2))
+            return _periter(fa_len, L0=4, target_s=0.6)[0]
+
+        cands = [(512, 512), (1024, 1024), (2048, 1024), (1024, 2048),
+                 (2048, 2048), (4096, 1024)]
+        key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
+        best, results = autotune.sweep("flash_attention", key, cands, timer)
+        autotune.save_default()
+        flops = 2 * 2 * SQ * SQ * DQ * HQ        # full: no causal halving
+        out = {"flash_attn_full_tuned_block": list(best),
+               "flash_attn_full_sweep": {
+                   f"{bq}x{bk}": flops / t / 1e12
+                   for (bq, bk), t in results.items()}}
+        _bank_tflops(out, "flash_attn_8k_bf16_full",
+                     flops / results[best] / 1e12, peak)
+        return out
+
+    _guarded(details, "flash_attn_full", cfg_flash_full, timeout_s=600)
 
     # ---- extra: fused (Pallas) vs einsum ring-attention hop --------------
     # One chip = a 1-rank ring, so this isolates the per-hop compute the
@@ -453,16 +617,51 @@ def main():
                 return min(_t(lambda: float(f(qr))) for _ in range(2))
             return run
 
-        t_fused = _marginal(ring_len(ring_flash_attention_kernel,
-                                     block_q=1024, block_k=1024),
-                            L0=4, min_delta=0.05)
-        t_einsum = _marginal(ring_len(ring_attention_kernel),
-                             L0=4, min_delta=0.05)
+        t_fused, _ = _periter(ring_len(ring_flash_attention_kernel,
+                                       block_q=1024, block_k=1024), L0=8)
+        t_einsum, _ = _periter(ring_len(ring_attention_kernel), L0=4)
         return {"ring_hop_fused_8k_bf16_s": t_fused,
                 "ring_hop_einsum_8k_bf16_s": t_einsum,
                 "ring_hop_fused_speedup": t_einsum / t_fused}
 
     _guarded(details, "ring_hop", cfg_ring)
+
+    # ---- extra: ring-attention TRAINING step (fused FA2 ring backward) ---
+    # the round-3 deliverable: grads through the Pallas ring path
+    def cfg_ring_train():
+        from distributedarrays_tpu import layout as L
+        from distributedarrays_tpu.models.ring_attention import (
+            ring_flash_attention_kernel)
+        from jax.sharding import PartitionSpec as RP
+        SR, HR, DR = 8192, 8, 64
+        mesh1 = L.mesh_for([0], (1,))
+        ax = mesh1.axis_names[0]
+        qr = jax.random.normal(jax.random.key(6), (SR, HR, DR), jnp.bfloat16)
+        shm = jax.shard_map(
+            lambda a, b, c: ring_flash_attention_kernel(
+                a, b, c, ax, causal=True, block_q=1024, block_k=1024),
+            mesh=mesh1, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
+            check_vma=False)
+        g = jax.grad(lambda x: jnp.sum(shm(x, x, x).astype(jnp.float32)))
+
+        def run(Ln):
+            @jax.jit
+            def f(qq):
+                def body(x, _):
+                    return (x + 1e-6 * g(x).astype(x.dtype)), None
+                x, _ = lax.scan(body, qq, None, length=Ln)
+                return jnp.sum(x.astype(jnp.float32))
+            float(f(qr))
+            return min(_t(lambda: float(f(qr))) for _ in range(2))
+
+        t_rt, _ = _periter(run, L0=2)
+        # fwd 2 matmuls + bwd 5 -> 3.5x fwd flops, causal half
+        flops = 3.5 * (2 * 2 * SR * SR * DR * HR / 2)
+        out = {"ring_train_8k_bf16_s_per_iter": t_rt}
+        _bank_tflops(out, "ring_train_8k_bf16", flops / t_rt / 1e12, peak)
+        return out
+
+    _guarded(details, "ring_train", cfg_ring_train, timeout_s=600)
 
     # ---- extra: hand-written Pallas GEMM kernel (compiled) ---------------
     def cfg_pallas_gemm():
@@ -481,9 +680,13 @@ def main():
             float(jf())
             return min(_t(lambda: float(jf())) for _ in range(2))
 
-        t_pg = _marginal(pg_len, L0=4, min_delta=0.05)
-        return {"pallas_gemm_4096_bf16_marginal_s": t_pg,
-                "pallas_gemm_4096_bf16_tflops": 2 * 4096**3 / t_pg / 1e12}
+        t_pg, L = _periter(pg_len, L0=16)
+        out = {"pallas_gemm_4096_bf16_s_per_iter": t_pg,
+               "pallas_gemm_4096_marginal_crosscheck_s":
+                   _marginal(pg_len, L0=4, min_delta=0.05)}
+        _bank_tflops(out, "pallas_gemm_4096_bf16",
+                     2 * 4096**3 / t_pg / 1e12, peak)
+        return out
 
     _guarded(details, "pallas_gemm", cfg_pallas_gemm)
 
@@ -507,20 +710,22 @@ def main():
                 jf = jax.jit(f)
                 float(jf())
                 return min(_t(lambda: float(jf())) for _ in range(2))
-            return _marginal(pg_len, L0=4, min_delta=0.05)
+            return _periter(pg_len, L0=8, target_s=0.6)[0]
 
         cands = [(1024, 1024, 512), (1024, 1024, 1024), (2048, 1024, 512),
                  (1024, 2048, 512), (512, 1024, 1024), (2048, 2048, 256)]
         key = autotune.key_for(NP, NP, NP, ap.dtype, bp.dtype)
         best, results = autotune.sweep("pallas_matmul", key, cands, timer)
         autotune.save_default()
-        return {
+        out = {
             "pallas_gemm_tuned_block": list(best),
-            "pallas_gemm_tuned_tflops": 2 * NP**3 / results[best] / 1e12,
             "pallas_gemm_sweep": {
                 "x".join(map(str, c)): 2 * NP**3 / t / 1e12
                 for c, t in results.items()},
         }
+        _bank_tflops(out, "pallas_gemm_tuned",
+                     2 * NP**3 / results[best] / 1e12, peak)
+        return out
 
     _guarded(details, "pallas_gemm_tune", cfg_pallas_gemm_tune,
              timeout_s=600)
@@ -547,11 +752,12 @@ def main():
             float(jf())
             return min(_t(lambda: float(jf())) for _ in range(2))
 
-        t_tr = _marginal(grad_len, L0=2, min_delta=0.05)
+        t_tr, L = _periter(grad_len, L0=4)
         # fwd 2 matmuls + bwd 5 -> 3.5x the fwd matmul flops, causal half
         flops = 3.5 * (2 * 2 * ST * ST * DT * HT / 2)
-        return {"flash_train_8k_bf16_marginal_s": t_tr,
-                "flash_train_8k_bf16_tflops": flops / t_tr / 1e12}
+        out = {"flash_train_8k_bf16_s_per_iter": t_tr}
+        _bank_tflops(out, "flash_train_8k_bf16", flops / t_tr / 1e12, peak)
+        return out
 
     _guarded(details, "flash_train", cfg_flash_train)
 
@@ -561,8 +767,8 @@ def main():
         cfg = T.Config(vocab=8192, dim=1024, heads=16, layers=8,
                        ffn_mult=4, max_seq=2048, dtype=jnp.bfloat16)
         params = T.init_params(jax.random.key(0), cfg)
-        B, S = 4, 2048
-        toks = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab)
+        Bt, St = 4, 2048
+        toks = jax.random.randint(jax.random.key(1), (Bt, St), 0, cfg.vocab)
         lr = jnp.float32(1e-4)
 
         def steps_len(L):
@@ -580,17 +786,18 @@ def main():
             float(f(params))
             return min(_t(lambda: float(f(params))) for _ in range(2))
 
-        t_step = _marginal(steps_len, L0=2, min_delta=0.1)
+        t_step, L = _periter(steps_len, L0=4)
         nparams = sum(int(np.prod(x.shape))
                       for x in jax.tree_util.tree_leaves(params))
-        toks_per_step = B * (S - 1)
-        return {
+        toks_per_step = Bt * (St - 1)
+        out = {
             "transformer_train_step_s": t_step,
             "transformer_train_tokens_per_s": toks_per_step / t_step,
             "transformer_train_params": nparams,
-            "transformer_train_tflops_est":
-                6 * nparams * toks_per_step / t_step / 1e12,
         }
+        _bank_tflops(out, "transformer_train_est",
+                     6 * nparams * toks_per_step / t_step / 1e12, peak)
+        return out
 
     _guarded(details, "transformer_train", cfg_transformer_train,
              timeout_s=600)
@@ -617,22 +824,18 @@ def main():
 
     # ---- last (riskiest): true-f32 GEMM (precision=HIGHEST) --------------
     # attempted after everything is banked, under a thread timeout: a
-    # wedged remote compile must not cost the run its other numbers.  The
-    # worker writes into its own dict, merged only if it finished (so a
-    # late completion cannot mutate `details` mid-serialization), and the
-    # headline is printed BEFORE touching the device again.
+    # wedged remote compile must not cost the run its other numbers.
     def highest():
-        t = _marginal(gemm_chain_at(jax.lax.Precision.HIGHEST), L0=50)
-        return {"gemm_4096_f32_highest_marginal_s": t,
+        t, L = _periter(gemm_chain_at(jax.lax.Precision.HIGHEST), L0=16)
+        return {"gemm_4096_f32_highest_s_per_iter": t,
                 "gemm_4096_f32_highest_gflops": 2 * N**3 / t / 1e9}
 
     _guarded(details, "gemm_f32_highest", highest, timeout_s=600)
 
     # the 16k f32-HIGHEST pass (the BASELINE config-3 metric), same guard
     def highest16():
-        t = _marginal(gemm16_chain_at(jax.lax.Precision.HIGHEST),
-                      L0=3, min_delta=0.2)
-        return {f"{tag}_f32_highest_marginal_s": t,
+        t, L = _periter(gemm16_chain_at(jax.lax.Precision.HIGHEST), L0=1)
+        return {f"{tag}_f32_highest_s_per_iter": t,
                 f"{tag}_f32_highest_gflops": 2 * K16**3 / t / 1e9}
 
     _guarded(details, f"{tag}_f32_highest", highest16, timeout_s=600)
