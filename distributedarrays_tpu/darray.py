@@ -610,7 +610,12 @@ class DArray:
                       procs=[int(p) for p in self.pids.flat])
 
     def __eq__(self, other):
-        # whole-array equality, like the reference's Base.== (darray.jl:403-441)
+        """WHOLE-ARRAY equality: one Python bool, True iff shapes match and
+        every element is equal — the reference's Base.== semantics
+        (darray.jl:403-441).  NOT numpy semantics: ``a == b`` never returns
+        an elementwise array here, while ``<``, ``<=``, ``>``, ``>=`` ARE
+        elementwise.  For an elementwise comparison use
+        ``dmap(jnp.equal, a, b)``."""
         if isinstance(other, (DArray, SubDArray)):
             other = np.asarray(other)
         elif not isinstance(other, (np.ndarray, jax.Array)):
@@ -884,19 +889,51 @@ def _to_sharding(data: jax.Array, sharding) -> jax.Array:
     return _put_global(data, sharding)
 
 
+def _spans_processes(sharding) -> bool:
+    """True when a sharding's devices belong to >1 controller process.
+    PROCESS-INDEPENDENT (unlike ``is_fully_addressable``): in
+    multi-controller SPMD every branch that can enter a compiled program
+    must be taken identically by every process, or the job deadlocks."""
+    try:
+        return len({d.process_index for d in sharding.device_set}) > 1
+    except Exception:
+        return False
+
+
 def _put_global(host, sharding) -> jax.Array:
     """Place host/device data under ``sharding``.
 
     Single-controller: one ``device_put`` (the DestinationSerializer scatter,
-    serialize.jl:45-87).  Multi-controller (a mesh spanning hosts, where some
-    devices are non-addressable): every process calls this with the same
-    global data and contributes only its addressable shards — the JAX analog
-    of each worker receiving only its own chunk."""
+    serialize.jl:45-87).  Multi-controller: device data that spans
+    processes is resharded by ONE compiled identity program — XLA inserts
+    the DCN/ICI collective; eager ``device_put`` cannot move bytes between
+    hosts.  Host data: every process calls this with the same global array
+    and contributes only its addressable shards — the JAX analog of each
+    worker receiving only its own chunk.  All branch predicates here are
+    process-independent (see ``_spans_processes``); the branches that may
+    diverge per process (`device_put` vs `make_array_from_callback`) are
+    both collective-free."""
+    if isinstance(host, jax.Array) and _spans_processes(host.sharding):
+        if host.sharding.device_set == sharding.device_set:
+            # same devices, new layout: ONE compiled identity program
+            # (_resharder is lru_cached on the sharding — no per-call
+            # retrace)
+            return _resharder(sharding)(host)
+        # device sets differ (e.g. a reduction shrank the rank grid below
+        # the process count): replicate over the SOURCE mesh — compiled,
+        # every owning process participates — then fall through to the
+        # host-scatter path with the local replica every process now holds
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = _resharder(NamedSharding(
+            host.sharding.mesh, PartitionSpec()))(host)
+        host = np.asarray(rep.addressable_data(0))
     if getattr(sharding, "is_fully_addressable", True):
         return jax.device_put(host, sharding)
     arr = np.asarray(host)
+    # explicit dtype: a process owning NO shard of this array (device-
+    # subset layouts) cannot infer it from the callback
     return jax.make_array_from_callback(
-        arr.shape, sharding, lambda idx: arr[idx])
+        arr.shape, sharding, lambda idx: arr[idx], dtype=arr.dtype)
 
 
 def _place_chunked(host, pids: np.ndarray, cuts, sharding) -> jax.Array:

@@ -170,7 +170,14 @@ def stencil5(d: DArray, iters: int = 1,
              temporal: int | None = None) -> DArray:
     """``iters`` 5-point Laplacian steps with zero boundary — the
     reference pattern (docs/src/index.md:160-181), as ``stencil3x3`` with
-    the Laplacian weights.  See ``stencil3x3`` for the knobs."""
+    the Laplacian weights.  See ``stencil3x3`` for the knobs.
+
+    Note on bitwise reproducibility: for ``iters > 1`` on TPU the kernel
+    auto-enables temporal blocking (up to 8 steps per launch), which
+    changes the floating-point summation order — results drift by
+    rounding noise, not bitwise-identical to the per-step kernel.  Pass
+    ``temporal=1`` to force one halo exchange per step and recover the
+    round-2 launch-per-step numerics."""
     from ..ops.pallas_stencil import LAPLACIAN_3X3
     return stencil3x3(d, LAPLACIAN_3X3, iters, use_pallas, temporal)
 

@@ -86,8 +86,21 @@ def _align_devices(raw, sharding):
     for r in raw:
         if isinstance(r, jax.Array) and r.sharding.device_set != target:
             try:
-                r = jax.device_put(r, mesh_sh)  # rank-compatible: reshard
-            except Exception:
+                from ..darray import _put_global
+                # rank-compatible reshard; _put_global picks the eager
+                # device_put (single-controller) or the compiled/gathered
+                # multi-controller move
+                r = _put_global(r, mesh_sh)
+            except ValueError as e:
+                # rank-incompatible spec (e.g. a scalar arg vs a 2-D
+                # sharding): replicating over the target mesh is the
+                # documented degradation — visible, not silent
+                from ..utils.debug import warn_once
+                warn_once(
+                    f"_align_devices:{r.ndim}d",
+                    f"broadcast: arg with shape {r.shape} cannot take the "
+                    f"target sharding ({e}); replicating it over the "
+                    f"target mesh instead")
                 r = jax.device_put(  # fallback: replicate over target mesh
                     r, jax.sharding.NamedSharding(
                         mesh_sh.mesh, jax.sharding.PartitionSpec()))

@@ -294,8 +294,21 @@ def map_localparts(f: Callable, *ds, procs=None):
             res = jax.jit(shmapped)(*raw)
             return _wrap_global(res, procs=[int(p) for p in d0.pids.flat],
                                 dist=list(d0.pids.shape))
-        except Exception:
-            pass  # fall through to the host path
+        except Exception as e:
+            # legitimate reasons to fall back: f untraceable, or f changes
+            # the chunk shape (out_specs mismatch).  Either way the host
+            # loop below re-runs f — a genuine error inside f surfaces
+            # there — but the silent 100x slowdown must not be silent:
+            from ..utils.debug import warn_once
+            # stable key: qualname (or the callable's TYPE for partials/
+            # callable objects) — a repr would embed id() and defeat the
+            # once-per-site dedup
+            fname = getattr(f, "__qualname__", None) or type(f).__name__
+            warn_once(
+                f"map_localparts:{fname}",
+                f"map_localparts: shard_map fast path failed for "
+                f"{fname!r} ({type(e).__name__}: {e}); falling back to "
+                f"the eager host loop (untraceable or shape-changing f)")
     grid = d0.pids.shape
     for a in ds:
         if isinstance(a, DArray) and a.dims != d0.dims:

@@ -26,7 +26,7 @@ from jax.sharding import Mesh
 from .. import layout as L
 
 __all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
-           "host_local_slice"]
+           "host_local_slice", "gather_global"]
 
 
 def initialize(coordinator_address: str | None = None,
@@ -86,6 +86,47 @@ def sync_hosts(name: str = "sync") -> None:
     if jax.process_count() > 1:  # pragma: no cover - needs real multi-host
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+
+def gather_global(d) -> np.ndarray:
+    """Host numpy copy of a DArray (or jax.Array) that may SPAN controller
+    processes — the multi-controller analog of the reference's ``Array(d)``
+    gather (darray.jl:211-224), which pulls every remote chunk to the
+    caller.
+
+    EVERY process must call this (SPMD discipline); every branch predicate
+    is process-independent so no process can wander into a collective
+    alone.  Three cases: data on this process only → direct fetch; data
+    spanning processes → one compiled replication program (an XLA
+    all-gather over DCN+ICI); data owned by a process SUBSET → the owners
+    fetch locally and a host-level allgather (the ``jax.distributed``
+    client's CPU collective) hands the bytes to everyone else."""
+    arr = d.garray if hasattr(d, "garray") else d
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    procs_of = sorted({dev.process_index for dev in arr.sharding.device_set})
+    me = jax.process_index()
+    if len(procs_of) > 1:
+        # every owning process joins the compiled replication; with data
+        # spanning all processes this is fully symmetric
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..darray import _resharder
+        if me in procs_of:
+            # _resharder is lru_cached on the sharding — no per-call retrace
+            rep = _resharder(NamedSharding(
+                arr.sharding.mesh, PartitionSpec()))(arr)
+            val = np.asarray(rep.addressable_data(0))
+        else:
+            val = np.zeros(arr.shape, np.dtype(arr.dtype))
+    elif me in procs_of:
+        val = np.asarray(arr)                    # sole owner: local fetch
+    else:
+        val = np.zeros(arr.shape, np.dtype(arr.dtype))
+    if len(procs_of) < jax.process_count():
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(val)
+        val = np.asarray(out[procs_of[0]])
+    return val
 
 
 def host_local_slice(d) -> list:

@@ -40,16 +40,23 @@ def key_for(*parts) -> str:
 
 def default_cache_path() -> str:
     """Where tuning results persist across processes: the
-    ``DAT_AUTOTUNE_CACHE`` env var if set, else ``AUTOTUNE_CACHE.json``
-    next to the package (the repo root in a checkout) — bench.py's
-    hardware sweep writes there so every later process in the same tree
-    picks the tuned blocks up automatically."""
+    ``DAT_AUTOTUNE_CACHE`` env var if set; in a repo CHECKOUT, an
+    ``AUTOTUNE_CACHE.json`` next to the package (gitignored) so bench.py's
+    hardware sweep is picked up by every later process in the same tree;
+    for an installed package, a per-user cache dir (never site-packages,
+    which may be read-only or shared across unrelated projects)."""
     env = os.environ.get("DAT_AUTOTUNE_CACHE")
     if env:
         return env
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    return os.path.join(pkg_parent, "AUTOTUNE_CACHE.json")
+    # .git is a directory in a normal clone, a FILE in worktrees/submodules
+    if os.path.exists(os.path.join(pkg_parent, ".git")):
+        return os.path.join(pkg_parent, "AUTOTUNE_CACHE.json")
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "distributedarrays_tpu",
+                        "AUTOTUNE_CACHE.json")
 
 
 def save_default() -> str:
@@ -92,6 +99,9 @@ def clear() -> None:
 
 def save(path: str) -> None:
     with _LOCK:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(_REGISTRY, f, indent=2, sort_keys=True)

@@ -16,13 +16,31 @@ sprinkled into long-running jobs:
 
 from __future__ import annotations
 
+import threading
+import warnings
+
 import numpy as np
 
 from .. import core
 from .. import layout as L
 from ..darray import DArray
 
-__all__ = ["validate", "check_all"]
+__all__ = ["validate", "check_all", "warn_once"]
+
+_warned: set = set()
+_warned_lock = threading.Lock()
+
+
+def warn_once(key: str, msg: str, stacklevel: int = 3) -> None:
+    """Emit ``msg`` as a RuntimeWarning the FIRST time ``key`` is seen in
+    this process.  Used by ops that take a documented fallback path (e.g.
+    shard_map → host loop) so the degradation is visible exactly once
+    instead of silently eating performance (VERDICT round-2 item 7)."""
+    with _warned_lock:
+        if key in _warned:
+            return
+        _warned.add(key)
+    warnings.warn(msg, RuntimeWarning, stacklevel=stacklevel)
 
 
 def _check(cond: bool, msg: str) -> None:

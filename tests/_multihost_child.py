@@ -60,6 +60,50 @@ assert len(local_pids) == 4, local_pids
 lp = d.localpart(local_pids[0])
 assert int(np.asarray(lp).size) == 2
 
+# --- gather a non-fully-addressable DArray back to every host -------------
+got = multihost.gather_global(d)
+assert np.array_equal(got, A), got
 d.close()
+
+# --- core ops END-TO-END across controllers (VERDICT round-3 item 4) ------
+# every process executes the same program on the same data; results are
+# checked against numpy oracles gathered through the DCN all-gather.
+
+# elementwise (djit broadcast fusion) over the global mesh
+X = np.linspace(-1.0, 1.0, 64, dtype=np.float32).reshape(16, 4)
+dx = dat.distribute(X)                      # even 2-D layout spans processes
+assert not dx.garray.is_fully_addressable
+ew = dat.djit(lambda a: jnp.sin(a) * 2 + 1)(dx)
+np.testing.assert_allclose(multihost.gather_global(ew), np.sin(X) * 2 + 1,
+                           rtol=1e-6, atol=1e-6)
+
+# reduction: dims-reduction + whole-array mapreduce
+col = dat.dsum(dx, dims=0)
+np.testing.assert_allclose(multihost.gather_global(col),
+                           X.sum(axis=0, keepdims=True), rtol=1e-5)
+tot = float(dat.dmapreduce(jnp.square, "sum", dx).addressable_data(0))
+np.testing.assert_allclose(tot, (X ** 2).sum(), rtol=1e-5)
+
+# GEMM over a 2x4 process-spanning grid (XLA SUMMA over the DCN mesh)
+Am = np.arange(32.0 * 16, dtype=np.float32).reshape(32, 16) / 100
+Bm = np.arange(16.0 * 8, dtype=np.float32).reshape(16, 8) / 100
+da = dat.distribute(Am, procs=range(8), dist=(2, 4))
+db = dat.distribute(Bm, procs=range(8), dist=(4, 2))
+dc = da @ db
+np.testing.assert_allclose(multihost.gather_global(dc), Am @ Bm,
+                           rtol=1e-4, atol=1e-5)
+
+# uneven (blocked-padded) ctor across processes: the _place_chunked
+# non-addressable branch
+U = np.arange(50.0 * 8, dtype=np.float32).reshape(50, 8)
+du = dat.distribute(U, procs=range(8), dist=(4, 2))
+assert [int(c) for c in np.diff(du.cuts[0])] == [13, 13, 12, 12]
+np.testing.assert_allclose(multihost.gather_global(du), U)
+u2 = du + du
+np.testing.assert_allclose(multihost.gather_global(u2), U * 2)
+
+for a in (dx, ew, col, da, db, dc, du, u2):
+    a.close()
+dat.d_closeall()
 multihost.sync_hosts("done")
 print(f"MULTIHOST_OK proc={proc_id}")

@@ -66,3 +66,24 @@ def pytest_configure(config):
     if not _ON_REAL:
         assert len(jax.devices()) == 8, (
             f"test harness expects 8 virtual devices, got {jax.devices()}")
+    config.addinivalue_line(
+        "markers", "slow: long-running test (property fuzz, training "
+        "convergence, subprocess clusters); run with --runslow or "
+        "DAT_TEST_SLOW=1 — CI always runs them")
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="include tests marked slow (default loop skips them to stay "
+             "under ~5 minutes; CI sets DAT_TEST_SLOW=1 for the full run)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or \
+            os.environ.get("DAT_TEST_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow; use --runslow / DAT_TEST_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

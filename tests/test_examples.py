@@ -15,6 +15,9 @@ FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py",
         "cg_poisson.py"]
 
 
+
+pytestmark = pytest.mark.slow  # fuzz/subprocess-heavy: full run in CI (--runslow)
+
 @pytest.mark.parametrize("script", FAST)
 def test_example_runs(script):
     env = dict(os.environ, EXAMPLES_FORCE_CPU="1")

@@ -281,3 +281,39 @@ def test_dreduce_numpy_ufunc_binary():
     d = dat.distribute(A)
     assert float(dat.dreduce(np.maximum, d)) == A.max()
     assert np.isclose(float(dat.dreduce(np.add, d)), A.sum())
+
+
+# ---------------------------------------------------------------------------
+# round-3 (VERDICT item 7): fallbacks warn once, genuine errors propagate
+# ---------------------------------------------------------------------------
+
+
+def test_map_localparts_fallback_warns_once(rng):
+    import warnings as W
+    from distributedarrays_tpu.ops.mapreduce import map_localparts
+
+    def untraceable_chunk_fn(a):
+        return np.asarray(a) * 2        # numpy on a tracer -> trace fails
+
+    d = dat.distribute(rng.standard_normal((32, 8)).astype(np.float32))
+    with W.catch_warnings(record=True) as rec:
+        W.simplefilter("always")
+        r = map_localparts(untraceable_chunk_fn, d)
+        r2 = map_localparts(untraceable_chunk_fn, d)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(d) * 2)
+    np.testing.assert_allclose(np.asarray(r2), np.asarray(d) * 2)
+    msgs = [w for w in rec if "shard_map fast path" in str(w.message)]
+    assert len(msgs) == 1, [str(w.message) for w in rec]  # once per site
+    dat.d_closeall()
+
+
+def test_map_localparts_genuine_error_propagates(rng):
+    from distributedarrays_tpu.ops.mapreduce import map_localparts
+
+    def broken_fn(a):
+        raise RuntimeError("kernel bug 0xdead")
+
+    d = dat.distribute(rng.standard_normal((16, 4)).astype(np.float32))
+    with pytest.raises(RuntimeError, match="kernel bug 0xdead"):
+        map_localparts(broken_fn, d)
+    dat.d_closeall()

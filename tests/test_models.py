@@ -345,6 +345,7 @@ def test_similar_and_deepcopy(rng):
     assert np.array_equal(np.asarray(dc), A)
 
 
+@pytest.mark.slow
 def test_graft_entry_points():
     import __graft_entry__ as g
     fn, args = g.entry()

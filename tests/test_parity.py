@@ -168,6 +168,7 @@ def test_bool_semantics():
     assert bool(dat.dzeros((1,))) is False
 
 
+@pytest.mark.slow
 def test_matmul_property(rng):
     # random GEMM shapes across random layouts vs numpy
     for _ in range(6):

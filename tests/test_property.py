@@ -16,6 +16,9 @@ dims_2d = st.tuples(st.integers(1, 64), st.integers(1, 48))
 nranks = st.integers(1, 8)
 
 
+
+pytestmark = pytest.mark.slow  # fuzz/subprocess-heavy: full run in CI (--runslow)
+
 @settings(max_examples=40, deadline=None)
 @given(sz=st.integers(1, 500), nc=st.integers(1, 12))
 def test_cuts_tile_exactly(sz, nc):

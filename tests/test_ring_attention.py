@@ -199,6 +199,7 @@ def test_zigzag_ring_causal_matches_dense(rng):
     dat.d_closeall()
 
 
+@pytest.mark.slow
 def test_zigzag_ring_differentiable(rng):
     from distributedarrays_tpu import layout as L
     from distributedarrays_tpu.models.ring_attention import (

@@ -50,11 +50,13 @@ def trained():
     return cfg, mesh, params, tokens, losses
 
 
+@pytest.mark.slow
 def test_transformer_learns_counting(trained):
     cfg, mesh, params, tokens, losses = trained
     assert losses[-1] < 0.3 * losses[0], losses[::10]
 
 
+@pytest.mark.slow
 def test_transformer_predictions(trained):
     # after training, argmax next-token should mostly be (t+1) % vocab
     cfg, mesh, params, tokens, _ = trained
@@ -65,6 +67,7 @@ def test_transformer_predictions(trained):
     assert acc > 0.8, acc
 
 
+@pytest.mark.slow
 def test_transformer_sharding_layout(trained):
     cfg, mesh, params, _, _ = trained
     b = params["blocks"][0]
