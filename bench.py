@@ -586,6 +586,48 @@ def main():
 
     _guarded(details, "flash_attn_full", cfg_flash_full, timeout_s=600)
 
+    # ---- extra: d=128 flash MFU (VERDICT round-3 item 5) -----------------
+    # at d=64 BOTH flash matmuls carry a 64-wide dim (QK^T contracts over
+    # d, PV's N is d), so each MXU pass uses half the 128x128 array — a
+    # ~50% MFU ceiling no tiling can lift.  d=128 fills the array; this
+    # config shows the kernel's MFU where the hardware allows >60%.
+    def cfg_flash_d128():
+        from distributedarrays_tpu.ops.pallas_attention import flash_attention
+        from distributedarrays_tpu.utils import autotune
+        SQ, HQ, DQ = 8192, 4, 128              # same bytes as the 8x64 run
+        q = jax.random.normal(jax.random.key(7), (SQ, HQ, DQ), jnp.bfloat16)
+
+        def timer(cfg):
+            bq, bk = cfg
+
+            def fa_len(L):
+                def f():
+                    def body(x, _):
+                        return flash_attention(x, q, q, causal=False,
+                                               block_q=bq, block_k=bk), None
+                    x, _ = lax.scan(body, q, None, length=L)
+                    return jnp.sum(x.astype(jnp.float32))
+                jf = jax.jit(f)
+                float(jf())
+                return min(_t(lambda: float(jf())) for _ in range(2))
+            return _periter(fa_len, L0=4, target_s=0.6)[0]
+
+        cands = [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
+                 (2048, 512), (2048, 1024)]
+        key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
+        best, results = autotune.sweep("flash_attention", key, cands, timer)
+        autotune.save_default()
+        flops = 2 * 2 * SQ * SQ * DQ * HQ
+        out = {"flash_attn_d128_tuned_block": list(best),
+               "flash_attn_d128_sweep": {
+                   f"{bq}x{bk}": flops / t / 1e12
+                   for (bq, bk), t in results.items()}}
+        _bank_tflops(out, "flash_attn_8k_bf16_d128_full",
+                     flops / results[best] / 1e12, peak)
+        return out
+
+    _guarded(details, "flash_attn_d128", cfg_flash_d128, timeout_s=600)
+
     # ---- extra: fused (Pallas) vs einsum ring-attention hop --------------
     # One chip = a 1-rank ring, so this isolates the per-hop compute the
     # ring pipelines against ppermute: the fused path must be >= the

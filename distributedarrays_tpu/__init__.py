@@ -15,7 +15,7 @@ See SURVEY.md at the repo root for the layer-by-layer mapping.
 from .core import (allowscalar, close, d_closeall, next_did, procs, registry,
                    live_ids, current_rank)
 from .darray import (DArray, SubDArray, SubOrDArray, DData, darray,
-                     darray_like, from_chunks, dzeros, dones, dfill, drand,
+                     darray_like, dfromfunction, from_chunks, dzeros, dones, dfill, drand,
                      drandint, dsample, drandn, distribute, ddata, gather, localpart,
                      localindices, locate, makelocal, seed, copyto_, dcat,
                      dfetch, isassigned)

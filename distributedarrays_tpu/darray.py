@@ -42,6 +42,7 @@ __all__ = [
     "DData",
     "darray",
     "darray_like",
+    "dfromfunction",
     "from_chunks",
     "dzeros",
     "dones",
@@ -1016,6 +1017,46 @@ def darray_like(init: Callable, d: DArray) -> DArray:
     """Same-layout ctor (reference ``DArray(init, d::DArray)``, darray.jl:234)."""
     pids = [int(p) for p in d.pids.flat]
     return darray(init, d.dims, pids, list(d.pids.shape))
+
+
+def dfromfunction(f: Callable, dims, procs=None, dist=None,
+                  compiled: bool = True) -> DArray:
+    """Build a DArray from a function of GLOBAL indices — the first-class
+    analog of the reference's ``@DArray [f(i, j) for i in .., j in ..]``
+    comprehension ctor (darray.jl:214-231), with ``np.fromfunction``
+    calling conventions: ``f`` receives one index-grid array per
+    dimension (0-based) and returns the element values.
+
+    ``compiled=True`` (default, for traceable ``f``): the whole array is
+    built in ONE jitted program with the target sharding — each device
+    materializes only its own chunk's iota and values, nothing is shipped
+    from host.  ``compiled=False`` (or automatically when ``f`` is not
+    traceable): per-chunk host evaluation through ``darray``, matching
+    the reference's eager comprehension semantics for arbitrary code.
+    """
+    dims = tuple(int(d) for d in dims)
+    if compiled:
+        _, pids, idxs, cuts, sharding = _resolve_layout(dims, procs, dist)
+
+        def build():
+            grids = jnp.meshgrid(
+                *[jnp.arange(n) for n in dims], indexing="ij") \
+                if dims else []
+            return jnp.asarray(f(*grids))
+        try:
+            out = jax.jit(build, out_shardings=sharding)()
+        except Exception:
+            out = None                # untraceable f: eager per-chunk path
+        if out is not None:
+            if tuple(out.shape) != dims:
+                raise ValueError(
+                    f"f returned shape {tuple(out.shape)}, expected {dims}")
+            return DArray(out, pids, idxs, cuts)
+    return darray(
+        lambda idx: np.fromfunction(
+            lambda *gs: f(*[g + r.start for g, r in zip(gs, idx)]),
+            tuple(len(r) for r in idx), dtype=int),
+        dims, procs, dist)
 
 
 def from_chunks(chunks: np.ndarray, procs=None) -> DArray:

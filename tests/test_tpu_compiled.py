@@ -84,6 +84,86 @@ def test_flash_attention_hop_compiled():
     assert np.abs(got - want).max() < 2e-2
 
 
+def test_flash_attention_hop_bwd_compiled():
+    # the FA2 hop-backward kernels through Mosaic (SMEM offsets, f32
+    # contribution outputs): two-hop composition of contributions must
+    # match the dense gradient (VERDICT round-3 item 3 hardware leg)
+    from distributedarrays_tpu.ops.pallas_attention import (
+        _LANE, flash_attention_hop, flash_attention_hop_bwd,
+        flash_carry_finalize, flash_carry_init)
+    S, H, D = 512, 4, 64
+    kq, kk, kv = jax.random.split(jax.random.key(4), 3)
+    q = jax.random.normal(kq, (S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (S, H, D), jnp.float32)
+    v = jax.random.normal(kv, (S, H, D), jnp.float32)
+    qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
+    half = S // 2
+    q0, k0, v0 = qh[:, :half], kh[:, :half], vh[:, :half]
+    k1, v1 = kh[:, half:], vh[:, half:]
+    sc = float(1.0 / np.sqrt(D))
+
+    # forward over both hops for rank-0's q block, collecting out + lse
+    m, l, a = flash_carry_init(H, half, D)
+    m, l, a = flash_attention_hop(q0, k0, v0, m, l, a, 0, 0, causal=True)
+    m, l, a = flash_attention_hop(q0, k1, v1, m, l, a, 0, half, causal=True)
+    oh, lse = flash_carry_finalize(m, l, a, q.dtype)
+
+    g = jnp.ones_like(oh)                                 # dL/dout = 1
+    dd = jnp.einsum("hbd,hbd->hb", g.astype(jnp.float32),
+                    oh.astype(jnp.float32))
+    ddb = jnp.broadcast_to(dd[:, :, None], (H, half, _LANE))
+    lseb = jnp.broadcast_to(lse[:, :, None], (H, half, _LANE))
+    dq = jnp.zeros((H, half, D), jnp.float32)
+    dqc, dk0, dv0 = flash_attention_hop_bwd(q0, k0, v0, g, lseb, ddb,
+                                            0, 0, causal=True)
+    dq = dq + dqc
+    dqc, dk1, dv1 = flash_attention_hop_bwd(q0, k1, v1, g, lseb, ddb,
+                                            0, half, causal=True)
+    dq = dq + dqc
+
+    def dense_loss(qq, kk_, vv):
+        s = jnp.einsum("hqd,hkd->hqk", qq.astype(jnp.float32) * sc,
+                       kk_.astype(jnp.float32))
+        qi = jnp.arange(half)[:, None]
+        ki = jnp.arange(S)[None, :]
+        s = jnp.where((ki <= qi)[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("hqk,hkd->hqd", p, vv.astype(jnp.float32)))
+
+    gd = jax.grad(dense_loss, (0, 1, 2))(q0, kh, vh)
+    denom = max(float(jnp.abs(x).max()) for x in gd)
+    assert float(jnp.abs(dq - gd[0]).max()) / denom < 5e-2
+    dk = jnp.concatenate([dk0, dk1], axis=1)
+    dv = jnp.concatenate([dv0, dv1], axis=1)
+    assert float(jnp.abs(dk - gd[1]).max()) / denom < 5e-2
+    assert float(jnp.abs(dv - gd[2]).max()) / denom < 5e-2
+
+
+def test_ring_flash_differentiable_compiled():
+    # the full custom_vjp ring path on a 1-rank ring: forward + backward
+    # compile through Mosaic and match dense gradients
+    from jax.sharding import PartitionSpec as P
+    from distributedarrays_tpu import layout as L
+    from distributedarrays_tpu.models.ring_attention import (
+        ring_flash_attention_kernel)
+    from distributedarrays_tpu.ops.pallas_attention import (
+        _dense_attention_shd)
+    S, H, D = 1024, 4, 64
+    q = jax.random.normal(jax.random.key(5), (S, H, D), jnp.float32)
+    mesh = L.mesh_for([0], (1, 1, 1))
+    ax = mesh.axis_names[0]
+    shm = jax.shard_map(
+        lambda a, b, c: ring_flash_attention_kernel(a, b, c, ax,
+                                                    causal=True),
+        mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax), check_vma=False)
+    g = jax.jit(jax.grad(lambda x: jnp.sum(shm(x, x, x) ** 2)))(q)
+    sc = float(1.0 / np.sqrt(D))
+    gd = jax.grad(lambda x: jnp.sum(
+        _dense_attention_shd(x, x, x, True, sc) ** 2))(q)
+    denom = float(jnp.abs(gd).max())
+    assert float(jnp.abs(g - gd).max()) / denom < 5e-2
+
+
 def test_pallas_matmul_compiled():
     from distributedarrays_tpu.ops.pallas_gemm import pallas_matmul
     for dt, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 2e-2)):
