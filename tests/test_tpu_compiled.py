@@ -175,6 +175,18 @@ def test_pallas_matmul_compiled():
         assert rel < tol, (dt, rel)
 
 
+def test_pallas_matmul_int8_compiled():
+    # int8 x int8 -> int32 through the real MXU (Mosaic int8 tiling): the
+    # dequantized result must track the f32 oracle within quantization error
+    from distributedarrays_tpu.ops.pallas_gemm import quantized_matmul
+    a = jax.random.normal(jax.random.key(8), (2048, 2048), jnp.float32)
+    b = jax.random.normal(jax.random.key(9), (2048, 2048), jnp.float32)
+    got = np.asarray(quantized_matmul(a, b))
+    want = np.asarray(jnp.matmul(a, b))
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 2e-2, rel
+
+
 def test_pallas_stencil_compiled():
     from distributedarrays_tpu.ops.pallas_stencil import stencil5_block
     rng = np.random.default_rng(0)

@@ -171,6 +171,46 @@ def test_pallas_matmul_validation(rng):
         pallas_matmul(b, a)
 
 
+def test_quantized_matmul_interpret(rng):
+    from distributedarrays_tpu.ops.pallas_gemm import quantized_matmul
+    a = rng.standard_normal((256, 384)).astype(np.float32)
+    b = rng.standard_normal((384, 128)).astype(np.float32)
+    got = np.asarray(quantized_matmul(a, b, interpret=True))
+    want = a @ b
+    assert np.abs(got - want).max() / np.abs(want).max() < 2e-2
+
+
+def test_pallas_matmul_int8_exact_accumulation(rng):
+    # the int8 path's only error is the two quantization roundings: the
+    # int32 accumulate + fused dequant must reproduce the integer oracle
+    # bit-for-bit (scaled), including an all-zero row (scale 0, not NaN)
+    from distributedarrays_tpu.ops.pallas_gemm import (pallas_matmul_int8,
+                                                       quantize_rows)
+    a = rng.standard_normal((128, 256)).astype(np.float32)
+    a[0] = 0.0
+    b = rng.standard_normal((256, 128)).astype(np.float32)
+    qa, sa = quantize_rows(a, 1)
+    qb, sb = quantize_rows(b, 0)
+    got = np.asarray(pallas_matmul_int8(qa, qb, sa, sb, interpret=True))
+    want = (np.asarray(qa, np.int32) @ np.asarray(qb, np.int32)
+            ).astype(np.float32) * np.asarray(sa)[:, None] \
+        * np.asarray(sb)[None, :]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert np.all(got[0] == 0) and np.all(np.isfinite(got))
+
+
+def test_pallas_matmul_int8_validation(rng):
+    from distributedarrays_tpu.ops.pallas_gemm import (pallas_matmul_int8,
+                                                       quantize_rows)
+    a = rng.standard_normal((128, 128)).astype(np.float32)
+    qa, sa = quantize_rows(a, 1)
+    with pytest.raises(ValueError, match="int8"):
+        pallas_matmul_int8(a, qa, sa, sa, interpret=True)
+    with pytest.raises(ValueError, match="divide"):
+        pallas_matmul_int8(qa, qa, sa, sa, block=(100, 64, 64),
+                           interpret=True)
+
+
 # ---------------------------------------------------------------------------
 # CheckpointManager: stepped async saves + rotation (design.md round-3
 # item 1; the reference has no checkpoint subsystem at all, SURVEY.md §5)
@@ -383,3 +423,29 @@ def test_pallas_matmul_malformed_tuned_entry_degrades(rng, tmp_path,
     autotune.record("pallas_matmul", key, [128, 128, 128])
     got = np.asarray(pallas_matmul(a, a))
     assert np.allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_pallas_matmul_int8_malformed_tuned_entry_degrades(
+        rng, tmp_path, monkeypatch):
+    # the int8 path shares _resolve_block: a cache entry that divides the
+    # shape but violates Mosaic int8 alignment (m%32/n%128/k%128) must
+    # degrade to the heuristic, not reach the kernel build
+    from distributedarrays_tpu.ops import pallas_gemm as pg
+    autotune = _isolate_autotune(monkeypatch, tmp_path)
+    a = rng.standard_normal((256, 256)).astype(np.float32)
+    qa, sa = pg.quantize_rows(a, 1)
+    qb, sb = pg.quantize_rows(a, 0)
+    want = np.asarray(pg.pallas_matmul_int8(qa, qb, sa, sb, interpret=True))
+    key = autotune.key_for(256, 256, 256, "int8")
+    # force the non-interpret resolution path to prove alignment filtering
+    # (the kernel itself still runs in interpret mode on CPU)
+    for bad in ([8, 128, 128], [32, 64, 128], [32, 128, 64], "junk"):
+        autotune.record("pallas_matmul_int8", key, bad)
+        bm, bn, bk = pg._resolve_block(
+            256, 256, 256, None, False, kernel="pallas_matmul_int8",
+            dtype_key=("int8",), caps=(1024, 1024, 1024), m_align=32)
+        assert bm % 32 == 0 and bn % 128 == 0 and bk % 128 == 0, bad
+    # a valid tuned entry is honored end to end
+    autotune.record("pallas_matmul_int8", key, [128, 128, 128])
+    got = np.asarray(pg.pallas_matmul_int8(qa, qb, sa, sb, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
