@@ -1,6 +1,6 @@
 from . import broadcast, linalg, mapreduce, sort, sparse  # noqa: F401
 
-_LAZY = ("pallas_attention", "pallas_gemm")
+_LAZY = ("pallas_attention", "pallas_gemm", "collective_matmul")
 
 
 def __getattr__(name):

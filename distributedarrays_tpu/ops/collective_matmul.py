@@ -1,0 +1,132 @@
+"""Overlapped collective matmuls: ring all-gather GEMM and GEMM +
+reduce-scatter for tensor-parallel layers.
+
+The naive TP forward is ``all_gather(x) @ W_shard`` (layer in) and
+``reduce_scatter(x @ W_shard)`` (layer out): the collective and the matmul
+serialize, so ICI time adds to MXU time.  The collective-matmul
+formulation (the "overlap" recipe of the public scaling literature;
+substrate parity: the reference pipelines work against communication the
+same way with eager sends in its SPMD ring programs,
+/root/reference/src/spmd.jl:145-231) decomposes the GEMM into per-rank
+chunks and interleaves one chunk's matmul with the ``ppermute`` of the
+next, so XLA's async collectives hide the wire time behind the MXU:
+
+- ``allgather_matmul(x, w, axis)``  ≡ ``all_gather(x, axis) @ w`` —
+  the resident chunk multiplies while the next chunk rides the ring.
+- ``matmul_reducescatter(x, w, axis)`` ≡ ``reduce_scatter(x @ w, axis)``
+  — each rank computes destination blocks one at a time, accumulating
+  into a rotating partial sum.
+- ``tp_ffn(x, w1, w2, axis)`` — the two composed into a Megatron
+  sequence-parallel FFN (the AG -> act -> RS sandwich).
+
+All are shard_map-internal (like the ``parallel.collectives`` helpers):
+call them inside ``run_spmd``/``shard_map`` programs with ``axis`` bound
+to a mesh axis — see the ``tp_ffn`` train leg in ``__graft_entry__``'s
+multichip dryrun and tests/test_collectives.py for worked programs.
+They are differentiable (pure lax), so TP training steps use them
+directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.collectives import pshift
+
+__all__ = ["allgather_matmul", "matmul_reducescatter", "tp_ffn"]
+
+
+def allgather_matmul(x, w, axis: str):
+    """``all_gather(x, axis) @ w`` with the gather pipelined into the GEMM.
+
+    ``x``: this rank's ``(m_loc, k)`` row chunk of the gathered operand;
+    ``w``: the resident ``(k, n_loc)`` shard.  Returns
+    ``(p * m_loc, n_loc)`` — identical on every rank of ``axis`` iff
+    ``w`` is identical; in TP, ``w`` differs per rank and the result is
+    the rank's column shard of ``all_gather(x) @ W_full``.
+
+    Ring schedule: at step t the chunk originally from rank ``(r + t) %
+    p`` is resident; it multiplies ``w`` while ``pshift`` fetches the
+    next chunk from rank ``r + 1`` — compute covers the hop.  p - 1
+    hops total (the last resident chunk multiplies outside the loop).
+    """
+    p = lax.axis_size(axis)
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if p == 1:
+        return (x @ w).astype(out_dtype)
+    r = lax.axis_index(axis)
+    m_loc, _ = x.shape
+    n_loc = w.shape[1]
+    out = jnp.zeros((p * m_loc, n_loc), out_dtype)
+
+    def body(t, carry):
+        cur, out = carry
+        src = (r + t) % p                   # chunk cur originated at src
+        nxt = pshift(cur, axis, -1)         # fetch rank r+1's chunk
+        out = lax.dynamic_update_slice(out, (cur @ w).astype(out.dtype),
+                                       (src * m_loc, 0))
+        return nxt, out
+
+    cur, out = lax.fori_loop(0, p - 1, body, (x, out))
+    src = (r + p - 1) % p
+    return lax.dynamic_update_slice(out, (cur @ w).astype(out.dtype),
+                                    (src * m_loc, 0))
+
+
+def matmul_reducescatter(x, w, axis: str):
+    """``reduce_scatter(x @ w, axis)`` with the reduction pipelined into
+    the GEMM.
+
+    ``x``: ``(m, k_loc)`` — this rank's contraction shard of the left
+    operand; ``w``: ``(k_loc, n)`` resident shard.  The axis size must
+    divide ``m``; returns ``(m / p, n)``: rank r holds row block r of
+    ``sum_ranks(x_r @ w_r)``.
+
+    Ring schedule: the partial destined for each rank circulates; at step
+    t, rank r adds its contribution for destination ``(r - 1 - t) % p``
+    and forwards.  After p steps every block has collected all p
+    contributions and sits on its destination rank; each hop's
+    ``pshift`` overlaps the next block's matmul.
+    """
+    p = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    m, _ = x.shape
+    if m % p:
+        raise ValueError(
+            f"rows {m} must be divisible by the axis size {p}")
+    m_loc = m // p
+
+    def block(d):
+        return lax.dynamic_slice_in_dim(x, d * m_loc, m_loc, 0) @ w
+
+    if p == 1:
+        return block(0)
+
+    acc = block((r - 1) % p)
+
+    def body(t, acc):
+        acc = pshift(acc, axis, 1)          # forward to rank r+1
+        return acc + block((r - 1 - t) % p)
+
+    return lax.fori_loop(1, p, body, acc)
+
+
+def tp_ffn(x, w1, w2, axis: str, act=None):
+    """Megatron-style sequence-parallel FFN as one overlapped program:
+    ``reduce_scatter(act(all_gather(x) @ W1) @ W2)`` with both
+    collectives pipelined into their GEMMs.
+
+    ``x``: ``(s_loc, e)`` — the rank's sequence shard of the activations;
+    ``w1``: ``(e, f_loc)`` column shard; ``w2``: ``(f_loc, e)`` row
+    shard.  Returns the ``(s_loc, e)`` sequence shard of the FFN output.
+    The intermediate ``(s, f_loc)`` activation never exceeds 1/p of the
+    full ``(s, f)`` — the sequence-parallel memory win — and the two ring
+    collectives hide behind the two GEMMs.  Differentiable; use inside
+    ``shard_map`` (vmap the leading batch dim outside if present).
+    ``act``: activation between the GEMMs (default ``jax.nn.gelu``).
+    """
+    act = jax.nn.gelu if act is None else act
+    h = allgather_matmul(x, w1, axis)             # (s, f_loc)
+    return matmul_reducescatter(act(h), w2, axis)  # (s_loc, e)
