@@ -12,7 +12,7 @@ EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
 
 # fast examples only; the training demos are exercised by their own suites
 FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py",
-        "cg_poisson.py"]
+        "cg_poisson.py", "tp_overlap_demo.py"]
 
 
 
