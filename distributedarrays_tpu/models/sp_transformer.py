@@ -1,0 +1,173 @@
+"""Sequence-parallel transformer: long-context training as ONE shard_map
+program per step.
+
+Where ``models/transformer.py`` is the GSPMD flagship (XLA infers the
+collectives from shardings), this model is the explicit-SPMD composition
+of the framework's round-3 pieces — activations stay sequence-sharded
+``(s_loc, e)`` end to end, so the full sequence never materializes on any
+chip:
+
+- attention: ``ring_flash_attention_kernel`` (context parallelism — K/V
+  blocks ride the ppermute ring through Pallas flash hops, differentiable
+  FA2 ring backward);
+- FFN: ``tp_ffn`` (ring all-gather GEMM -> gelu -> GEMM + reduce-scatter,
+  Megatron sequence-parallel layout, both hops pipelined behind the MXU);
+- loss: next-token cross-entropy with the shift crossing rank boundaries
+  via one ``pshift`` (each rank fetches its right neighbor's first
+  token), masked at the global sequence end, averaged with ``psum``.
+
+Batch folds into the head axis for attention (exact — causality is
+per-head) and into the row axis for the FFN (exact — the AG->RS ring
+returns each rank's rows to it), so one kernel call covers the batch.
+
+The reference's long-context substrate is its SPMD ring programs
+(/root/reference/test/spmd.jl:90-101); this is that substrate promoted to
+a trainable model family.  See tests/test_transformer.py for the
+dense-oracle gradient tests and ``__graft_entry__.dryrun_multichip`` for
+the multi-device training leg.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.collective_matmul import tp_ffn
+from ..parallel import collectives as C
+from .ring_attention import ring_flash_attention_kernel
+from .transformer import Config, _rmsnorm
+from .transformer import init_params as _transformer_init_params
+
+__all__ = ["SPConfig", "init_params", "param_specs", "forward_local",
+           "loss_local", "make_train_step"]
+
+
+class SPConfig(Config):
+    """transformer.Config plus the shard_map knobs: ``block_q``/``block_k``
+    feed the Pallas flash hops; ``interpret`` forces interpreter mode
+    (auto: on for non-TPU backends)."""
+
+    def __init__(self, vocab=256, dim=128, heads=4, layers=2, ffn_mult=4,
+                 max_seq=128, dtype=jnp.bfloat16, block_q=512, block_k=512,
+                 interpret=None):
+        super().__init__(vocab, dim, heads, layers, ffn_mult, max_seq,
+                         dtype)
+        self.block_q, self.block_k = block_q, block_k
+        self.interpret = interpret
+
+    def _key(self):
+        return super()._key() + (self.block_q, self.block_k, self.interpret)
+
+
+def init_params(key, cfg: SPConfig):
+    """Identical pytree to ``transformer.init_params`` (same family, same
+    init scheme); ``param_specs`` shards the FFN weights over the sp axis,
+    the rest replicated."""
+    return _transformer_init_params(key, cfg)
+
+
+def param_specs(cfg: SPConfig, axis: str = "p"):
+    """PartitionSpec pytree mirroring ``init_params``: w1 column-sharded,
+    w2 row-sharded over the sp axis (the Megatron layout ``tp_ffn``
+    expects), everything else replicated."""
+    blk = {"ln1": P(None), "qkv": P(None, None), "proj": P(None, None),
+           "ln2": P(None), "w1": P(None, axis), "w2": P(axis, None)}
+    return {"embed": P(None, None), "pos": P(None, None), "ln_f": P(None),
+            "head": P(None, None), "blocks": [dict(blk)] * cfg.layers}
+
+
+def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
+    """Per-rank forward inside shard_map.  ``tokens_loc``: ``(b, s_loc)``
+    — this rank's contiguous sequence chunk.  Returns ``(b, s_loc,
+    vocab)`` f32 logits for the rank's positions."""
+    Bt, S_loc = tokens_loc.shape
+    H = cfg.heads
+    E = cfg.dim
+    D = E // H
+    p = lax.axis_size(axis)                  # static at trace time
+    if S_loc * p > cfg.max_seq:
+        # dynamic_slice would CLAMP out-of-table position reads (silently
+        # reusing earlier ranks' embeddings); fail loudly instead, like
+        # the dense transformer.forward does
+        raise ValueError(
+            f"global sequence length {S_loc * p} exceeds max_seq "
+            f"{cfg.max_seq}")
+    me = lax.axis_index(axis)
+
+    pos = lax.dynamic_slice_in_dim(params["pos"], me * S_loc, S_loc, 0)
+    x = params["embed"][tokens_loc] + pos[None]          # (b, s_loc, e)
+
+    for blk in params["blocks"]:
+        h = _rmsnorm(x, blk["ln1"])
+        qkv = h @ blk["qkv"]                             # (b, s_loc, 3e)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def fold(t):
+            # (b, s_loc, e) -> (s_loc, b*h, d): batch folds into heads
+            return jnp.transpose(t.reshape(Bt, S_loc, H, D),
+                                 (1, 0, 2, 3)).reshape(S_loc, Bt * H, D)
+
+        o = ring_flash_attention_kernel(
+            fold(q), fold(k), fold(v), axis, causal=True,
+            block_q=cfg.block_q, block_k=cfg.block_k,
+            interpret=cfg.interpret)
+        o = jnp.transpose(o.reshape(S_loc, Bt, H, D),
+                          (1, 0, 2, 3)).reshape(Bt, S_loc, E)
+        x = x + o @ blk["proj"]
+
+        h2 = _rmsnorm(x, blk["ln2"])
+        # batch folds into rows: the AG->RS ring returns each rank's rows
+        f = tp_ffn(h2.reshape(Bt * S_loc, E), blk["w1"], blk["w2"], axis)
+        x = x + f.reshape(Bt, S_loc, E)
+
+    return (_rmsnorm(x, params["ln_f"]) @ params["head"]).astype(jnp.float32)
+
+
+def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
+    """Per-rank next-token CE.  The target for a rank's LAST position is
+    the NEXT rank's first token (one pshift); the final global position
+    has no target and is masked.  Returns the global mean loss (psum'd —
+    identical on every rank)."""
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    Bt, S_loc = tokens_loc.shape
+
+    logits = forward_local(params, tokens_loc, cfg, axis)
+    # right neighbor's first token arrives as my (b, 1) tail target
+    nxt_first = C.pshift(tokens_loc[:, :1], axis, -1)
+    targets = jnp.concatenate([tokens_loc[:, 1:], nxt_first], axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    valid = jnp.ones((Bt, S_loc), jnp.float32)
+    valid = valid.at[:, -1].set(jnp.where(me == p - 1, 0.0, 1.0))
+    total = lax.psum(jnp.sum(-ll * valid), axis)
+    count = lax.psum(jnp.sum(valid), axis)
+    return total / count
+
+
+def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):
+    """One jitted SGD train step over ``mesh``: tokens sharded ``(b,
+    s/p)``, grads for replicated params psum'd by shard_map's backward,
+    FFN-shard grads staying sharded.  Returns ``step(params, tokens, lr)
+    -> (params, loss)``."""
+    specs = param_specs(cfg, axis)
+
+    def local(params, tokens_loc, lr):
+        loss, g = jax.value_and_grad(loss_local)(params, tokens_loc, cfg,
+                                                 axis)
+        new = jax.tree_util.tree_map(
+            lambda pp, gg: (pp.astype(jnp.float32)
+                            - lr * gg.astype(jnp.float32)).astype(pp.dtype),
+            params, g)
+        return new, loss
+
+    shm = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(specs, P(None, axis), P()),
+        out_specs=(specs, P()),
+        check_vma=False)
+    return jax.jit(shm, donate_argnums=(0,))
