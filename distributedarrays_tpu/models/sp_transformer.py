@@ -38,7 +38,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.collective_matmul import tp_ffn
 from ..parallel import collectives as C
-from .ring_attention import ring_flash_attention_kernel
+from .ring_attention import (ring_flash_attention_kernel,
+                             zigzag_ring_flash_attention_kernel)
 from .transformer import Config, _rmsnorm
 from .transformer import init_params as _transformer_init_params
 
@@ -49,18 +50,22 @@ __all__ = ["SPConfig", "init_params", "param_specs", "forward_local",
 class SPConfig(Config):
     """transformer.Config plus the shard_map knobs: ``block_q``/``block_k``
     feed the Pallas flash hops; ``interpret`` forces interpreter mode
-    (auto: on for non-TPU backends)."""
+    (auto: on for non-TPU backends); ``zigzag`` switches to the
+    load-balanced causal layout (rank i holds sequence-chunk pair
+    ``(i, 2P-1-i)`` — feed tokens permuted by ``zigzag_order``)."""
 
     def __init__(self, vocab=256, dim=128, heads=4, layers=2, ffn_mult=4,
                  max_seq=128, dtype=jnp.bfloat16, block_q=512, block_k=512,
-                 interpret=None):
+                 interpret=None, zigzag=False):
         super().__init__(vocab, dim, heads, layers, ffn_mult, max_seq,
                          dtype)
         self.block_q, self.block_k = block_q, block_k
         self.interpret = interpret
+        self.zigzag = bool(zigzag)
 
     def _key(self):
-        return super()._key() + (self.block_q, self.block_k, self.interpret)
+        return super()._key() + (self.block_q, self.block_k, self.interpret,
+                                 self.zigzag)
 
 
 def init_params(key, cfg: SPConfig):
@@ -82,8 +87,11 @@ def param_specs(cfg: SPConfig, axis: str = "p"):
 
 def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
     """Per-rank forward inside shard_map.  ``tokens_loc``: ``(b, s_loc)``
-    — this rank's contiguous sequence chunk.  Returns ``(b, s_loc,
-    vocab)`` f32 logits for the rank's positions."""
+    — this rank's sequence chunk: contiguous by default, or the
+    ``(i, 2p-1-i)`` chunk pair when ``cfg.zigzag`` (shard tokens
+    pre-permuted by ``ring_attention.zigzag_order``).  Returns ``(b,
+    s_loc, vocab)`` f32 logits for the rank's positions (same layout as
+    the input chunk)."""
     Bt, S_loc = tokens_loc.shape
     H = cfg.heads
     E = cfg.dim
@@ -98,7 +106,17 @@ def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
             f"{cfg.max_seq}")
     me = lax.axis_index(axis)
 
-    pos = lax.dynamic_slice_in_dim(params["pos"], me * S_loc, S_loc, 0)
+    if cfg.zigzag:
+        # rank's positions are the chunk pair (me, 2p-1-me), C2 each
+        if S_loc % 2:
+            raise ValueError(
+                f"zigzag needs an even per-rank length, got {S_loc}")
+        C2 = S_loc // 2
+        ar = jnp.arange(C2)
+        idx = jnp.concatenate([me * C2 + ar, (2 * p - 1 - me) * C2 + ar])
+        pos = jnp.take(params["pos"], idx, axis=0)
+    else:
+        pos = lax.dynamic_slice_in_dim(params["pos"], me * S_loc, S_loc, 0)
     x = params["embed"][tokens_loc] + pos[None]          # (b, s_loc, e)
 
     for blk in params["blocks"]:
@@ -111,10 +129,16 @@ def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
             return jnp.transpose(t.reshape(Bt, S_loc, H, D),
                                  (1, 0, 2, 3)).reshape(S_loc, Bt * H, D)
 
-        o = ring_flash_attention_kernel(
-            fold(q), fold(k), fold(v), axis, causal=True,
-            block_q=cfg.block_q, block_k=cfg.block_k,
-            interpret=cfg.interpret)
+        if cfg.zigzag:
+            o = zigzag_ring_flash_attention_kernel(
+                fold(q), fold(k), fold(v), axis,
+                block_q=cfg.block_q, block_k=cfg.block_k,
+                interpret=cfg.interpret)
+        else:
+            o = ring_flash_attention_kernel(
+                fold(q), fold(k), fold(v), axis, causal=True,
+                block_q=cfg.block_q, block_k=cfg.block_k,
+                interpret=cfg.interpret)
         o = jnp.transpose(o.reshape(S_loc, Bt, H, D),
                           (1, 0, 2, 3)).reshape(Bt, S_loc, E)
         x = x + o @ blk["proj"]
@@ -128,22 +152,39 @@ def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
 
 
 def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
-    """Per-rank next-token CE.  The target for a rank's LAST position is
-    the NEXT rank's first token (one pshift); the final global position
-    has no target and is masked.  Returns the global mean loss (psum'd —
-    identical on every rank)."""
+    """Per-rank next-token CE.  Chunk-tail targets live on statically
+    known neighbor ranks, so the shift is one ``pshift`` per chunk; the
+    final global position has no target and is masked.  Returns the
+    global mean loss (psum'd — identical on every rank).
+
+    Contiguous layout: rank i's tail target is rank i+1's first token;
+    rank p-1's tail is the global end (masked).  Zigzag layout (chunk
+    pair ``(i, 2p-1-i)``): chunk i's successor i+1 is rank i+1's FIRST
+    chunk (rank p-1's: its own second chunk), and chunk ``2p-1-i``'s
+    successor ``2p-i`` is rank i-1's SECOND chunk (rank 0's: the global
+    end, masked)."""
     p = lax.axis_size(axis)
     me = lax.axis_index(axis)
     Bt, S_loc = tokens_loc.shape
 
     logits = forward_local(params, tokens_loc, cfg, axis)
-    # right neighbor's first token arrives as my (b, 1) tail target
-    nxt_first = C.pshift(tokens_loc[:, :1], axis, -1)
-    targets = jnp.concatenate([tokens_loc[:, 1:], nxt_first], axis=1)
+    if cfg.zigzag:
+        C2 = S_loc // 2
+        ta, tb = tokens_loc[:, :C2], tokens_loc[:, C2:]
+        nxt_a = C.pshift(ta[:, :1], axis, -1)        # rank i+1's chunk-a head
+        nxt_a = jnp.where(me == p - 1, tb[:, :1], nxt_a)
+        nxt_b = C.pshift(tb[:, :1], axis, 1)         # rank i-1's chunk-b head
+        targets = jnp.concatenate([ta[:, 1:], nxt_a, tb[:, 1:], nxt_b],
+                                  axis=1)
+        end_rank = 0                                 # chunk 2p-1 sits on rank 0
+    else:
+        nxt_first = C.pshift(tokens_loc[:, :1], axis, -1)
+        targets = jnp.concatenate([tokens_loc[:, 1:], nxt_first], axis=1)
+        end_rank = p - 1
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     valid = jnp.ones((Bt, S_loc), jnp.float32)
-    valid = valid.at[:, -1].set(jnp.where(me == p - 1, 0.0, 1.0))
+    valid = valid.at[:, -1].set(jnp.where(me == end_rank, 0.0, 1.0))
     total = lax.psum(jnp.sum(-ll * valid), axis)
     count = lax.psum(jnp.sum(valid), axis)
     return total / count
