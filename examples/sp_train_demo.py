@@ -1,0 +1,56 @@
+"""Long-context training demo: the sequence-parallel transformer.
+
+One `shard_map` program per train step — activations stay
+sequence-sharded on every rank, attention is the differentiable fused
+ring (Pallas flash hops with the online-softmax carry riding ppermute),
+the FFN is the overlapped `tp_ffn`, and the next-token shift crosses
+rank boundaries with one `pshift`.  Trains the same counting task as
+`examples/train_transformer.py`, then repeats it in the zigzag
+(load-balanced causal) layout.
+"""
+
+import _setup  # noqa: F401
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from distributedarrays_tpu.models import sp_transformer as SPT
+from distributedarrays_tpu.models.ring_attention import zigzag_order
+from distributedarrays_tpu.parallel import collectives as C
+
+p = min(4, len(jax.devices()))
+mesh = C.spmd_mesh(p)
+S = 8 * p
+cfg = SPT.SPConfig(vocab=32, dim=64, heads=4, layers=2, max_seq=S,
+                   dtype=jnp.float32, block_q=8, block_k=8)
+
+# counting task: next token = (t + 1) % vocab
+start = jax.random.randint(jax.random.key(1), (8, 1), 0, cfg.vocab)
+tokens = ((start + jnp.arange(S)[None]) % cfg.vocab).astype(jnp.int32)
+
+step = SPT.make_train_step(mesh, cfg)
+params = SPT.init_params(jax.random.key(0), cfg)
+losses = []
+for i in range(40):
+    params, loss = step(params, tokens, jnp.float32(0.1))
+    losses.append(float(loss))
+print(f"sequence-parallel over {p} ranks ({S // p} positions/rank): "
+      f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+assert losses[-1] < 0.5 * losses[0]
+
+# same task, zigzag layout: rank i holds chunk pair (i, 2p-1-i)
+zcfg = SPT.SPConfig(vocab=32, dim=64, heads=4, layers=2, max_seq=S,
+                    dtype=jnp.float32, block_q=4, block_k=4, zigzag=True)
+zz_tokens = jnp.asarray(np.asarray(tokens)[:, np.asarray(zigzag_order(S, p))])
+zstep = SPT.make_train_step(mesh, zcfg)
+zparams = SPT.init_params(jax.random.key(0), zcfg)
+zlosses = []
+for i in range(40):
+    zparams, zloss = zstep(zparams, zz_tokens, jnp.float32(0.1))
+    zlosses.append(float(zloss))
+print(f"zigzag (load-balanced causal): loss {zlosses[0]:.3f} -> "
+      f"{zlosses[-1]:.3f}")
+assert zlosses[-1] < 0.5 * zlosses[0]
+print("OK")
