@@ -24,8 +24,8 @@ from .layout import (defaultdist, defaultdist_1d, chunk_idxs, mesh_for,
 from .ops.broadcast import dmap, dmap_into, djit, broadcasted
 from .ops.mapreduce import (dreduce, dmapreduce, dsum, dprod, dmaximum,
                             dminimum, dmean, dstd, dvar, dall, dany, dcount,
-                            dextrema, map_localparts, map_localparts_into,
-                            samedist, mapslices, ppeval)
+                            dextrema, dcumsum, dcumprod, map_localparts,
+                            map_localparts_into, samedist, mapslices, ppeval)
 from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
                          rmul_diag, matmul, mul_into, dtranspose, dadjoint)
 from .ops.sort import dsort

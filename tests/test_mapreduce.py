@@ -317,3 +317,58 @@ def test_map_localparts_genuine_error_propagates(rng):
     with pytest.raises(RuntimeError, match="kernel bug 0xdead"):
         map_localparts(broken_fn, d)
     dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# round-3: distributed scans (parallel prefix) — dcumsum / dcumprod
+# ---------------------------------------------------------------------------
+
+
+def test_dcumsum_sharded_axis(rng):
+    A = rng.standard_normal((32, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    got = dat.dcumsum(d, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(A, axis=0),
+                               rtol=1e-5, atol=1e-5)
+    assert got.cuts == d.cuts
+    got1 = dat.dcumsum(d, axis=1)
+    np.testing.assert_allclose(np.asarray(got1), np.cumsum(A, axis=1),
+                               rtol=1e-5, atol=1e-5)
+    dat.d_closeall()
+
+
+def test_dcumsum_unsharded_axis_and_negative(rng):
+    A = rng.standard_normal((16, 6)).astype(np.float32)
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))   # dim 1 unsharded
+    got = dat.dcumsum(d, axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(A, axis=1),
+                               rtol=1e-5, atol=1e-5)
+    dat.d_closeall()
+
+
+def test_dcumprod_and_int_dtype(rng):
+    A = rng.integers(1, 3, (24,)).astype(np.int32)
+    d = dat.distribute(A, procs=range(8))
+    got = dat.dcumprod(d)
+    np.testing.assert_array_equal(np.asarray(got), np.cumprod(A))
+    assert got.dtype == jnp.int32
+    dat.d_closeall()
+
+
+def test_dcumsum_uneven_layout_keeps_cuts(rng):
+    A = rng.standard_normal((50,)).astype(np.float32)
+    d = dat.distribute(A, procs=range(4))     # cuts [0,13,26,38,50]
+    got = dat.dcumsum(d)
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(A),
+                               rtol=1e-4, atol=1e-4)
+    assert got.cuts == d.cuts
+    dat.d_closeall()
+
+
+def test_dcumsum_validation(rng):
+    d = dat.dzeros((8,), procs=range(4))
+    with pytest.raises(ValueError, match="axis"):
+        dat.dcumsum(d, axis=2)
+    with pytest.raises(TypeError, match="DArray"):
+        dat.dcumsum(np.zeros(4))
+    dat.d_closeall()
