@@ -151,11 +151,12 @@ def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
     return (_rmsnorm(x, params["ln_f"]) @ params["head"]).astype(jnp.float32)
 
 
-def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
-    """Per-rank next-token CE.  Chunk-tail targets live on statically
-    known neighbor ranks, so the shift is one ``pshift`` per chunk; the
-    final global position has no target and is masked.  Returns the
-    global mean loss (psum'd — identical on every rank).
+def _loss_partial(params, tokens_loc, cfg: SPConfig, axis: str):
+    """This rank's share of the next-token CE: local masked total over
+    the GLOBAL valid count.  Summing (psum) over ranks gives the global
+    mean loss.  Chunk-tail targets live on statically known neighbor
+    ranks, so the shift is one ``pshift`` per chunk; the final global
+    position has no target and is masked.
 
     Contiguous layout: rank i's tail target is rank i+1's first token;
     rank p-1's tail is the global end (masked).  Zigzag layout (chunk
@@ -185,21 +186,44 @@ def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     valid = jnp.ones((Bt, S_loc), jnp.float32)
     valid = valid.at[:, -1].set(jnp.where(me == end_rank, 0.0, 1.0))
-    total = lax.psum(jnp.sum(-ll * valid), axis)
+    # count is data-independent of params; the psum carries no gradient
     count = lax.psum(jnp.sum(valid), axis)
-    return total / count
+    return jnp.sum(-ll * valid) / count
+
+
+def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
+    """Global mean next-token CE (psum'd — identical on every rank).
+    For training use ``_loss_partial`` under ``value_and_grad`` and psum
+    the value afterwards: differentiating THROUGH this psum scales every
+    gradient by the axis size (psum's SPMD transpose is another psum)."""
+    return lax.psum(_loss_partial(params, tokens_loc, cfg, axis), axis)
 
 
 def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):
     """One jitted SGD train step over ``mesh``: tokens sharded ``(b,
-    s/p)``, grads for replicated params psum'd by shard_map's backward,
-    FFN-shard grads staying sharded.  Returns ``step(params, tokens, lr)
-    -> (params, loss)``."""
+    s/p)``; replicated-param grads are psum'd EXPLICITLY (check_vma=False
+    disables shard_map's automatic replication accounting), FFN-shard
+    grads stay sharded.  Returns ``step(params, tokens, lr) -> (params,
+    loss)``."""
     specs = param_specs(cfg, axis)
 
     def local(params, tokens_loc, lr):
-        loss, g = jax.value_and_grad(loss_local)(params, tokens_loc, cfg,
-                                                 axis)
+        # differentiate the PARTIAL loss: grads of the psum'd mean would
+        # come back scaled by the axis size (psum transposes to psum)
+        part, g = jax.value_and_grad(_loss_partial)(params, tokens_loc,
+                                                    cfg, axis)
+        loss = lax.psum(part, axis)
+        # check_vma=False puts replication maintenance on us: each rank's
+        # grad for a REPLICATED param is only its partial (its own token
+        # shard's contribution) — without this psum the per-rank param
+        # copies silently diverge after the first update (caught by the
+        # checkpoint round-trip test: save() reads shard 0).  Sharded
+        # params (w1/w2) already receive their cross-rank contributions
+        # through the ring collectives' transposes.
+        g = jax.tree_util.tree_map(
+            lambda spec, gg: (lax.psum(gg, axis)
+                              if all(s is None for s in spec) else gg),
+            specs, g)
         new = jax.tree_util.tree_map(
             lambda pp, gg: (pp.astype(jnp.float32)
                             - lr * gg.astype(jnp.float32)).astype(pp.dtype),
