@@ -14,7 +14,8 @@ EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
 # it is the only end-to-end run of both sp layouts as a user would launch
 # them); the big training demos are exercised by their own suites
 FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py",
-        "cg_poisson.py", "tp_overlap_demo.py", "sp_train_demo.py"]
+        "cg_poisson.py", "tp_overlap_demo.py", "sp_train_demo.py",
+        "spectral_poisson.py"]
 
 
 

@@ -160,6 +160,7 @@ def test_sp_transformer_loss_matches_dense_ce(sp_setup):
     assert abs(float(loss) - want) / want < 1e-4
 
 
+@pytest.mark.slow
 def test_sp_transformer_trains(sp_setup):
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
     step = SPT.make_train_step(mesh, cfg)
@@ -214,6 +215,7 @@ def test_sp_transformer_zigzag_matches_dense(sp_setup):
     assert abs(float(loss) - want_loss) / want_loss < 1e-4
 
 
+@pytest.mark.slow
 def test_sp_transformer_zigzag_trains(sp_setup):
     from distributedarrays_tpu.models.ring_attention import zigzag_order
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
@@ -232,6 +234,7 @@ def test_sp_transformer_zigzag_trains(sp_setup):
     assert all(np.isfinite(v) for v in losses)
 
 
+@pytest.mark.slow
 def test_sp_transformer_checkpoint_roundtrip(sp_setup, tmp_path):
     # training state (incl. the tp-sharded FFN weights produced by the
     # donated train step) must survive save/load and continue identically
