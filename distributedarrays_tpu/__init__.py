@@ -24,7 +24,7 @@ from .layout import (defaultdist, defaultdist_1d, chunk_idxs, mesh_for,
 from .ops.broadcast import dmap, dmap_into, djit, broadcasted
 from .ops.mapreduce import (dreduce, dmapreduce, dsum, dprod, dmaximum,
                             dminimum, dmean, dstd, dvar, dall, dany, dcount,
-                            dextrema, dcumsum, dcumprod, map_localparts,
+                            dextrema, dcumsum, dcumprod, dcummax, dcummin, map_localparts,
                             map_localparts_into, samedist, mapslices, ppeval)
 from .ops.fft import dfft, difft, dfft2, difft2
 from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,

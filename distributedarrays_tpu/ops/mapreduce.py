@@ -34,7 +34,7 @@ from .broadcast import _jitted, _unwrap, _align_devices, elementwise
 __all__ = [
     "dreduce", "dmapreduce", "dsum", "dprod", "dmaximum", "dminimum",
     "dmean", "dstd", "dvar", "dall", "dany", "dcount", "dextrema",
-    "dcumsum", "dcumprod",
+    "dcumsum", "dcumprod", "dcummax", "dcummin",
     "map_localparts", "map_localparts_into", "samedist", "mapslices", "ppeval",
 ]
 
@@ -299,23 +299,56 @@ def _scan_impl(d: DArray, axis: int, kind: str) -> DArray:
 
     # uneven: host scan, exact cut structure kept (one device_put)
     full = np.asarray(d)
-    scanned = np.cumsum(full, axis=ax) if kind == "sum" \
-        else np.cumprod(full, axis=ax)
+    scanned = _SCAN_NP[kind](full, axis=ax)
     from ..darray import darray_from_cuts
     return darray_from_cuts(scanned, [int(p) for p in d.pids.flat], d.cuts)
 
 
+# kind -> (local scan, host scan, cross-rank combine, elementwise merge)
+_SCAN_NP = {"sum": np.cumsum, "prod": np.cumprod,
+            "max": np.maximum.accumulate, "min": np.minimum.accumulate}
+def _cum_extreme(op):
+    def f(a, axis):
+        if jnp.issubdtype(a.dtype, jnp.bool_):
+            # lax.cummax/cummin reject bool; or-/and-scan via int8
+            return op(a.astype(jnp.int8), axis=axis).astype(jnp.bool_)
+        return op(a, axis=axis)
+    return f
+
+
+_SCAN_LOCAL = {"sum": jnp.cumsum, "prod": jnp.cumprod,
+               "max": _cum_extreme(jax.lax.cummax),
+               "min": _cum_extreme(jax.lax.cummin)}
+_SCAN_COMBINE = {"sum": jnp.sum, "prod": jnp.prod,
+                 "max": jnp.max, "min": jnp.min}
+_SCAN_MERGE = {"sum": jnp.add, "prod": jnp.multiply,
+               "max": jnp.maximum, "min": jnp.minimum}
+
+
+def _scan_neutral(kind: str, dtype):
+    """Identity element of the combine, dtype-aware for max/min: ±inf
+    for floats (finfo.min would corrupt data containing infinities),
+    False/True for bool (iinfo rejects it), iinfo bounds for ints."""
+    if kind in ("sum", "prod"):
+        return jnp.asarray(1 if kind == "prod" else 0, dtype)
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return jnp.asarray(kind == "min", dtype)
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.asarray(-jnp.inf if kind == "max" else jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.min if kind == "max" else info.max, dtype)
+
+
 @functools.lru_cache(maxsize=128)
 def _scan_local_jit(kind: str, ax: int):
-    op = jnp.cumsum if kind == "sum" else jnp.cumprod
+    op = _SCAN_LOCAL[kind]
     return jax.jit(lambda a: op(a, axis=ax))
 
 
 @functools.lru_cache(maxsize=128)
 def _scan_shm_jit(mesh, spec, kind: str, ax: int, name: str):
     """One compiled SPMD scan program per (mesh, spec, kind, axis)."""
-    local_scan = jnp.cumsum if kind == "sum" else jnp.cumprod
-    neutral = 0 if kind == "sum" else 1
+    local_scan = _SCAN_LOCAL[kind]
 
     def kernel(x):
         loc = local_scan(x, axis=ax)
@@ -325,10 +358,9 @@ def _scan_shm_jit(mesh, spec, kind: str, ax: int, name: str):
         r = jax.lax.axis_index(name)
         p = jax.lax.axis_size(name)
         mask = (jnp.arange(p) < r).reshape((p,) + (1,) * loc.ndim)
-        filled = jnp.where(mask, g, jnp.asarray(neutral, g.dtype))
-        prefix = (jnp.sum(filled, axis=0) if kind == "sum"
-                  else jnp.prod(filled, axis=0))
-        return loc + prefix if kind == "sum" else loc * prefix
+        filled = jnp.where(mask, g, _scan_neutral(kind, g.dtype))
+        prefix = _SCAN_COMBINE[kind](filled, axis=0)
+        return _SCAN_MERGE[kind](loc, prefix)
 
     return jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=spec,
                                  out_specs=spec))
@@ -345,6 +377,18 @@ def dcumprod(d: DArray, axis: int = 0) -> DArray:
     """Distributed cumulative product along ``axis`` (inclusive), same
     layout as ``d``."""
     return _scan_impl(d, axis, "prod")
+
+
+def dcummax(d: DArray, axis: int = 0) -> DArray:
+    """Distributed running maximum along ``axis`` (inclusive), same
+    layout as ``d``."""
+    return _scan_impl(d, axis, "max")
+
+
+def dcummin(d: DArray, axis: int = 0) -> DArray:
+    """Distributed running minimum along ``axis`` (inclusive), same
+    layout as ``d``."""
+    return _scan_impl(d, axis, "min")
 
 
 def map_localparts(f: Callable, *ds, procs=None):

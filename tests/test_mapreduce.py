@@ -372,3 +372,38 @@ def test_dcumsum_validation(rng):
     with pytest.raises(TypeError, match="DArray"):
         dat.dcumsum(np.zeros(4))
     dat.d_closeall()
+
+
+def test_dcummax_dcummin(rng):
+    A = rng.standard_normal((32, 8)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))
+    np.testing.assert_array_equal(np.asarray(dat.dcummax(d, axis=0)),
+                                  np.maximum.accumulate(A, axis=0))
+    np.testing.assert_array_equal(np.asarray(dat.dcummin(d, axis=1)),
+                                  np.minimum.accumulate(A, axis=1))
+    # int dtype neutral (iinfo, not -inf)
+    B = rng.integers(-50, 50, (24,)).astype(np.int32)
+    db = dat.distribute(B, procs=range(8))
+    np.testing.assert_array_equal(np.asarray(dat.dcummax(db)),
+                                  np.maximum.accumulate(B))
+    # uneven host path
+    V = dat.distribute(rng.standard_normal(50).astype(np.float32),
+                       procs=range(4))
+    np.testing.assert_array_equal(np.asarray(dat.dcummin(V)),
+                                  np.minimum.accumulate(np.asarray(V)))
+    dat.d_closeall()
+
+
+def test_dcummax_bool_and_inf_edge_cases(rng):
+    # bool dtype on the sharded axis (iinfo would reject bool), and a
+    # leading all -inf chunk (finfo.min neutral would corrupt -inf data)
+    B = rng.random(24) > 0.5
+    db = dat.distribute(B, procs=range(8))
+    np.testing.assert_array_equal(np.asarray(dat.dcummax(db)),
+                                  np.maximum.accumulate(B))
+    A = rng.standard_normal(32).astype(np.float32)
+    A[:4] = -np.inf                          # rank 0's whole chunk
+    da = dat.distribute(A, procs=range(8))
+    np.testing.assert_array_equal(np.asarray(dat.dcummax(da)),
+                                  np.maximum.accumulate(A))
+    dat.d_closeall()
