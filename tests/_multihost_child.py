@@ -104,6 +104,21 @@ np.testing.assert_allclose(multihost.gather_global(u2), U * 2)
 
 for a in (dx, ew, col, da, db, dc, du, u2):
     a.close()
+
+# --- round-3 ops across controllers: prefix scan + FFT all_to_all ---------
+S1 = np.arange(64.0, dtype=np.float32).reshape(16, 4) / 7
+ds = dat.distribute(S1)                     # layout spans both processes
+cs = dat.dcumsum(ds, axis=0)                # shard_map scan over the DCN mesh
+np.testing.assert_allclose(multihost.gather_global(cs),
+                           np.cumsum(S1, axis=0), rtol=1e-5, atol=1e-5)
+F1 = np.sin(np.arange(32.0 * 16, dtype=np.float32)).reshape(32, 16)
+dfm = dat.distribute(F1, procs=range(8), dist=(8, 1))
+ff = dat.dfft(dfm, axis=0)                  # all_to_all across processes
+np.testing.assert_allclose(multihost.gather_global(ff),
+                           np.fft.fft(F1, axis=0), rtol=1e-3, atol=1e-3)
+for a in (ds, cs, dfm, ff):
+    a.close()
+
 dat.d_closeall()
 multihost.sync_hosts("done")
 print(f"MULTIHOST_OK proc={proc_id}")
