@@ -141,3 +141,38 @@ def test_view_slices_match_numpy(dims, data):
     v = d[i0:i1, j0:j1]
     assert np.array_equal(np.asarray(v), A[i0:i1, j0:j1])
     dat.d_closeall()
+
+
+@settings(max_examples=25, deadline=None)
+@given(dims=dims_2d, n=nranks, data=st.data())
+def test_scans_match_numpy_any_layout(dims, n, data):
+    # round-3 prefix scans over arbitrary layouts (even -> shard_map
+    # path, uneven -> host path) against numpy accumulate oracles
+    g0 = data.draw(st.integers(1, n))
+    g1 = data.draw(st.integers(1, max(1, n // g0)))
+    ax = data.draw(st.integers(0, 1))
+    kind = data.draw(st.sampled_from(["sum", "max", "min"]))
+    A = np.arange(np.prod(dims), dtype=np.float32).reshape(dims) / 7 - 3
+    d = dat.distribute(A, procs=range(n), dist=(g0, g1))
+    fn = {"sum": dat.dcumsum, "max": dat.dcummax, "min": dat.dcummin}[kind]
+    want = {"sum": np.cumsum, "max": np.maximum.accumulate,
+            "min": np.minimum.accumulate}[kind](A, axis=ax)
+    got = fn(d, axis=ax)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+    assert got.cuts == d.cuts
+    dat.d_closeall()
+
+
+@settings(max_examples=15, deadline=None)
+@given(n=nranks, data=st.data())
+def test_dfft_matches_numpy_any_rowshard(n, data):
+    rows = data.draw(st.integers(1, 8)) * n       # divisible and not
+    cols = data.draw(st.integers(2, 24))
+    ax = data.draw(st.integers(0, 1))
+    A = (np.sin(np.arange(rows * cols, dtype=np.float32))
+         .reshape(rows, cols))
+    d = dat.distribute(A, procs=range(n), dist=(n, 1))
+    got = np.asarray(dat.dfft(d, axis=ax))
+    np.testing.assert_allclose(got, np.fft.fft(A, axis=ax).astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+    dat.d_closeall()
