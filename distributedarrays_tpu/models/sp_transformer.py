@@ -29,6 +29,8 @@ the multi-device training leg.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -44,7 +46,8 @@ from .transformer import Config, _rmsnorm
 from .transformer import init_params as _transformer_init_params
 
 __all__ = ["SPConfig", "init_params", "param_specs", "forward_local",
-           "loss_local", "make_train_step"]
+           "loss_local", "make_train_step", "make_grad_fn",
+           "make_optax_train_step"]
 
 
 class SPConfig(Config):
@@ -199,15 +202,14 @@ def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
     return lax.psum(_loss_partial(params, tokens_loc, cfg, axis), axis)
 
 
-def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):
-    """One jitted SGD train step over ``mesh``: tokens sharded ``(b,
-    s/p)``; replicated-param grads are psum'd EXPLICITLY (check_vma=False
-    disables shard_map's automatic replication accounting), FFN-shard
-    grads stay sharded.  Returns ``step(params, tokens, lr) -> (params,
-    loss)``."""
+def make_grad_fn(mesh, cfg: SPConfig, axis: str = "p"):
+    """The shard_map (loss, grads) program shared by both train steps:
+    tokens sharded ``(b, s/p)``, replicated-param grads psum'd
+    EXPLICITLY (check_vma=False disables shard_map's automatic
+    replication accounting), FFN-shard grads staying sharded."""
     specs = param_specs(cfg, axis)
 
-    def local(params, tokens_loc, lr):
+    def local(params, tokens_loc):
         # differentiate the PARTIAL loss: grads of the psum'd mean would
         # come back scaled by the axis size (psum transposes to psum)
         part, g = jax.value_and_grad(_loss_partial)(params, tokens_loc,
@@ -224,15 +226,55 @@ def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):
             lambda spec, gg: (lax.psum(gg, axis)
                               if all(s is None for s in spec) else gg),
             specs, g)
+        return loss, g
+
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(specs, P(None, axis)),
+                         out_specs=(P(), specs), check_vma=False)
+
+
+def make_optax_train_step(mesh, cfg: SPConfig, tx, axis: str = "p"):
+    """Training with any optax optimizer: the (loss, grads) shard_map
+    program from ``make_grad_fn`` composed with ``tx.update`` under ONE
+    jit — GSPMD lays the optimizer state out to match each param (Adam
+    moments for the tp-sharded FFN weights stay sharded, replicated
+    params' moments replicated).  Returns ``step`` with
+    ``step(params, opt_state, tokens) -> (params, opt_state, loss)``;
+    initialize the state with ``tx.init(params)``.
+
+    Example::
+
+        tx = optax.adamw(1e-3)
+        step = make_optax_train_step(mesh, cfg, tx)
+        state = tx.init(params)
+        params, state, loss = step(params, state, tokens)
+    """
+    grad_fn = make_grad_fn(mesh, cfg, axis)
+    import optax
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, g = grad_fn(params, tokens)
+        updates, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):
+    """One jitted SGD train step over ``mesh``: ``make_grad_fn``'s
+    gradient program plus the SGD update under one jit (use
+    ``make_optax_train_step`` for a real optimizer).  Returns
+    ``step(params, tokens, lr) -> (params, loss)``."""
+    grad_fn = make_grad_fn(mesh, cfg, axis)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(params, tokens, lr):
+        loss, g = grad_fn(params, tokens)
         new = jax.tree_util.tree_map(
             lambda pp, gg: (pp.astype(jnp.float32)
                             - lr * gg.astype(jnp.float32)).astype(pp.dtype),
             params, g)
         return new, loss
 
-    shm = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(specs, P(None, axis), P()),
-        out_specs=(specs, P()),
-        check_vma=False)
-    return jax.jit(shm, donate_argnums=(0,))
+    return step

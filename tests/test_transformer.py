@@ -287,3 +287,29 @@ def test_sp_transformer_update_matches_dense_sgd(sp_setup):
             for v in vals[1:]:
                 np.testing.assert_array_equal(vals[0], v,
                                               err_msg=jax.tree_util.keystr(k))
+
+
+def test_sp_transformer_optax_adamw(sp_setup):
+    # real-optimizer training path: grads from the shard_map program,
+    # Adam moments laid out by GSPMD to match each param (sharded FFN
+    # moments stay sharded)
+    import optax
+    SPT, C, p, mesh, cfg, params, tokens = sp_setup
+    tx = optax.adamw(3e-3)
+    step = SPT.make_optax_train_step(mesh, cfg, tx)
+    prm = SPT.init_params(jax.random.key(5), cfg)
+    state = tx.init(prm)
+    losses = []
+    for _ in range(10):
+        prm, state, l = step(prm, state, tokens)
+        losses.append(float(l))
+    assert losses[-1] < 0.8 * losses[0], losses
+    assert all(np.isfinite(v) for v in losses)
+
+    def axes(x):
+        s = tuple(x.sharding.spec)
+        return s + (None,) * (x.ndim - len(s))
+
+    # Adam mu for the column-sharded w1 must be sharded like w1
+    mu_w1 = state[0].mu["blocks"][0]["w1"]
+    assert axes(mu_w1) == axes(prm["blocks"][0]["w1"])
