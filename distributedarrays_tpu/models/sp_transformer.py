@@ -236,29 +236,23 @@ def make_grad_fn(mesh, cfg: SPConfig, axis: str = "p"):
 def make_optax_train_step(mesh, cfg: SPConfig, tx, axis: str = "p"):
     """Training with any optax optimizer: the (loss, grads) shard_map
     program from ``make_grad_fn`` composed with ``tx.update`` under ONE
-    jit — GSPMD lays the optimizer state out to match each param (Adam
-    moments for the tp-sharded FFN weights stay sharded, replicated
-    params' moments replicated).  Returns ``step`` with
-    ``step(params, opt_state, tokens) -> (params, opt_state, loss)``;
-    initialize the state with ``tx.init(params)``.
+    jit, in fp32 master precision (bf16 params/grads upcast for the
+    optimizer arithmetic — see ``transformer._optax_f32_step``) — GSPMD
+    lays the optimizer state out to match each param (Adam moments for
+    the tp-sharded FFN weights stay sharded, replicated params' moments
+    replicated).  Returns ``(step, init)``: ``state = init(params)``,
+    then ``step(params, opt_state, tokens) -> (params, opt_state,
+    loss)``.
 
     Example::
 
         tx = optax.adamw(1e-3)
-        step = make_optax_train_step(mesh, cfg, tx)
-        state = tx.init(params)
+        step, init = make_optax_train_step(mesh, cfg, tx)
+        state = init(params)
         params, state, loss = step(params, state, tokens)
     """
-    grad_fn = make_grad_fn(mesh, cfg, axis)
-    import optax
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, tokens):
-        loss, g = grad_fn(params, tokens)
-        updates, opt_state = tx.update(g, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    return step
+    from .transformer import _optax_f32_step
+    return _optax_f32_step(tx, make_grad_fn(mesh, cfg, axis))
 
 
 def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):

@@ -32,6 +32,7 @@ from ..ops.pallas_attention import flash_attention
 from .mlp import make_mesh
 
 __all__ = ["init_params", "forward", "loss_fn", "train_step",
+           "make_optax_train_step",
            "shard_params", "make_mesh", "Config"]
 
 
@@ -173,8 +174,53 @@ def loss_fn(params, tokens, cfg: Config):
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
 def train_step(params, tokens, lr, cfg: Config):
+    """One SGD step: value_and_grad of ``loss_fn`` + an fp32 update
+    (bf16 params upcast for the arithmetic, downcast after) with donated
+    buffers; GSPMD inserts the tp psums and dp grad all-reduce."""
     loss, g = jax.value_and_grad(loss_fn)(params, tokens, cfg)
     new = jax.tree_util.tree_map(
         lambda p, gg: (p.astype(jnp.float32) - lr * gg.astype(jnp.float32))
         .astype(p.dtype), params, g)
     return new, loss
+
+
+def _optax_f32_step(tx, grad_fn):
+    """Shared optax step with fp32 master arithmetic: bf16 params/grads
+    upcast before ``tx.update`` + ``apply_updates`` and downcast after —
+    at bf16 resolution (~8 mantissa bits) Adam-scale updates against
+    O(0.1) weights would otherwise round to zero and training silently
+    stalls.  State must be initialized from fp32 params (use the
+    returned ``init``)."""
+    import optax
+
+    def as32(t):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32), t)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, g = grad_fn(params, tokens)
+        p32 = as32(params)
+        updates, opt_state = tx.update(as32(g), opt_state, p32)
+        new32 = optax.apply_updates(p32, updates)
+        new = jax.tree_util.tree_map(
+            lambda n, p: n.astype(p.dtype), new32, params)
+        return new, opt_state, loss
+
+    def init(params):
+        return tx.init(as32(params))
+
+    return step, init
+
+
+def make_optax_train_step(cfg: Config, tx):
+    """Training with any optax optimizer under the GSPMD model: one jit
+    of value_and_grad + ``tx.update`` in fp32 master precision; XLA lays
+    the optimizer state out to match each param's sharding
+    (Megatron-sharded qkv/proj/w1/w2 moments stay tp-sharded).  Returns
+    ``(step, init)``: ``state = init(params)``, then
+    ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+    def grad_fn(params, tokens):
+        return jax.value_and_grad(loss_fn)(params, tokens, cfg)
+
+    return _optax_f32_step(tx, grad_fn)

@@ -14,6 +14,12 @@ from distributedarrays_tpu.ops.pallas_attention import (_dense_attention_shd,
                                                         flash_attention)
 
 
+def _axes(x):
+    """Normalized sharding spec (XLA may drop trailing Nones)."""
+    s = tuple(x.sharding.spec)
+    return s + (None,) * (x.ndim - len(s))
+
+
 def test_flash_custom_vjp_exact(rng):
     # gradients through the kernel == gradients of the dense formulation
     S, H, D = 64, 2, 16
@@ -72,14 +78,10 @@ def test_transformer_sharding_layout(trained):
     cfg, mesh, params, _, _ = trained
     b = params["blocks"][0]
 
-    def axes(x):  # normalized (XLA may drop trailing Nones)
-        s = tuple(x.sharding.spec)
-        return s + (None,) * (x.ndim - len(s))
-
-    assert axes(b["qkv"]) == (None, "tp")      # column-parallel
-    assert axes(b["proj"]) == ("tp", None)     # row-parallel
-    assert axes(b["w1"]) == (None, "tp")
-    assert axes(b["w2"]) == ("tp", None)
+    assert _axes(b["qkv"]) == (None, "tp")      # column-parallel
+    assert _axes(b["proj"]) == ("tp", None)     # row-parallel
+    assert _axes(b["w1"]) == (None, "tp")
+    assert _axes(b["w2"]) == ("tp", None)
 
 
 def test_config_validation():
@@ -296,20 +298,43 @@ def test_sp_transformer_optax_adamw(sp_setup):
     import optax
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
     tx = optax.adamw(3e-3)
-    step = SPT.make_optax_train_step(mesh, cfg, tx)
+    step, init = SPT.make_optax_train_step(mesh, cfg, tx)
     prm = SPT.init_params(jax.random.key(5), cfg)
-    state = tx.init(prm)
+    state = init(prm)
     losses = []
     for _ in range(10):
         prm, state, l = step(prm, state, tokens)
         losses.append(float(l))
     assert losses[-1] < 0.8 * losses[0], losses
     assert all(np.isfinite(v) for v in losses)
-
-    def axes(x):
-        s = tuple(x.sharding.spec)
-        return s + (None,) * (x.ndim - len(s))
-
-    # Adam mu for the column-sharded w1 must be sharded like w1
+    # Adam mu for the column-sharded w1 must be sharded like w1 (and f32)
     mu_w1 = state[0].mu["blocks"][0]["w1"]
-    assert axes(mu_w1) == axes(prm["blocks"][0]["w1"])
+    assert _axes(mu_w1) == _axes(prm["blocks"][0]["w1"])
+    assert mu_w1.dtype == jnp.float32
+
+
+def test_transformer_optax_adamw_sharded_moments():
+    # GSPMD flagship with a real optimizer at the DEFAULT bf16 dtype:
+    # the fp32 master-precision path must keep Adam-scale updates from
+    # rounding away in bf16, moments must inherit the Megatron tp
+    # sharding of their params, and training must converge
+    import optax
+    cfg = T.Config(vocab=32, dim=64, heads=4, layers=2, max_seq=32)
+    assert cfg.dtype == jnp.bfloat16
+    mesh = make_mesh(8)
+    params = T.shard_params(T.init_params(jax.random.key(0), cfg), mesh)
+    start = jax.random.randint(jax.random.key(1), (8, 1), 0, 32)
+    tokens = ((start + jnp.arange(32)[None]) % 32).astype(jnp.int32)
+    tokens = jax.device_put(tokens, jax.NamedSharding(mesh, P("dp", None)))
+    tx = optax.adamw(3e-3)
+    step, init = T.make_optax_train_step(cfg, tx)
+    state = init(params)
+    losses = []
+    for _ in range(10):
+        params, state, loss = step(params, state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < 0.8 * losses[0], losses
+    assert params["blocks"][0]["w1"].dtype == jnp.bfloat16
+    mu_w1 = state[0].mu["blocks"][0]["w1"]
+    assert mu_w1.dtype == jnp.float32
+    assert _axes(mu_w1) == _axes(params["blocks"][0]["w1"]) == (None, "tp")
