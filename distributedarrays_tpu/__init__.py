@@ -26,6 +26,7 @@ from .ops.mapreduce import (dreduce, dmapreduce, dsum, dprod, dmaximum,
                             dminimum, dmean, dstd, dvar, dall, dany, dcount,
                             dextrema, dcumsum, dcumprod, dcummax, dcummin, map_localparts,
                             map_localparts_into, samedist, mapslices, ppeval)
+from .ops.conv import dconv2d
 from .ops.fft import dfft, difft, dfft2, difft2
 from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
                          rmul_diag, matmul, mul_into, dtranspose, dadjoint)

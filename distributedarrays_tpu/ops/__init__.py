@@ -1,4 +1,4 @@
-from . import broadcast, fft, linalg, mapreduce, sort, sparse  # noqa: F401
+from . import broadcast, conv, fft, linalg, mapreduce, sort, sparse  # noqa: F401
 
 _LAZY = ("pallas_attention", "pallas_gemm", "collective_matmul")
 

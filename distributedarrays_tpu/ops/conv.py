@@ -1,0 +1,135 @@
+"""Distributed 2-D convolution: halo exchange + one local MXU conv.
+
+The conv counterpart of the stencil substrate (models/stencil.py is the
+fixed-3x3 weighted path; reference pattern: the eager halo sends of
+docs/src/index.md:160-181): the image is sharded along its height dim,
+each rank fetches ``kh//2`` boundary rows from its mesh neighbors with
+``halo_exchange`` (two ppermutes over ICI), and the convolution itself is
+one ``lax.conv_general_dilated`` per rank — which XLA lowers onto the
+MXU.  SAME zero padding; the non-wrapping halo exchange delivers zeros at
+the global edges, so results match the dense oracle exactly.
+
+``dconv2d`` accepts:
+- a ``(H, W)`` DArray with a ``(kh, kw)`` kernel (single channel), or
+- an ``(N, H, W, C)`` DArray with a ``(kh, kw, Cin, Cout)`` kernel
+  (NHWC batched), sharded along the height dim in both cases.
+
+Eligible layouts (even, sharded along height only, halo fitting the
+local block) run as ONE shard_map program; anything else warns once and
+takes a host gather + dense conv.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..darray import DArray, _wrap_global, darray_from_cuts
+from ..parallel.collectives import halo_exchange
+
+__all__ = ["dconv2d"]
+
+
+def _dense_conv(x, k):
+    """SAME zero-padded conv oracle on a full array (host/eligibility
+    fallback and the per-rank kernel's core).  Accumulates at
+    ``promote_types(x, float32)`` so complex inputs keep their imaginary
+    part and bf16 accumulates in f32; the result returns to x's dtype."""
+    acc = jnp.promote_types(jnp.result_type(x.dtype, k.dtype), jnp.float32)
+    if x.ndim == 2:
+        out = lax.conv_general_dilated(
+            x[None, :, :, None].astype(acc),
+            k[:, :, None, None].astype(acc),
+            window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return out[0, :, :, 0].astype(x.dtype)
+    out = lax.conv_general_dilated(
+        x.astype(acc), k.astype(acc),
+        window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out.astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=64)
+def _conv_shm_jit(mesh, spec, name: str, hdim: int, hh: int):
+    from jax.sharding import PartitionSpec
+
+    def kernel(x, k):
+        if hh:
+            lo, hi = halo_exchange(x, name, halo=hh, dim=hdim, wrap=False)
+            xp = jnp.concatenate([lo, x, hi], axis=hdim)
+        else:
+            xp = x
+        full = _dense_conv(xp, k)          # SAME over the halo'd block
+        return lax.slice_in_dim(full, hh, full.shape[hdim] - hh,
+                                axis=hdim)
+
+    return jax.jit(jax.shard_map(
+        kernel, mesh=mesh, in_specs=(spec, PartitionSpec()),
+        out_specs=spec))
+
+
+def dconv2d(d: DArray, kernel) -> DArray:
+    """SAME zero-padded 2-D convolution of a height-sharded DArray (see
+    module docstring for accepted shapes).  Output keeps ``d``'s layout
+    and dims (Cout replacing C in the NHWC case)."""
+    if not isinstance(d, DArray):
+        raise TypeError(f"expected DArray, got {type(d).__name__}")
+    k = jnp.asarray(kernel)
+    if d.ndim == 2:
+        if k.ndim != 2:
+            raise ValueError(f"(H, W) input needs a (kh, kw) kernel, "
+                             f"got {k.shape}")
+        hdim = 0
+    elif d.ndim == 4:
+        if k.ndim != 4:
+            raise ValueError(f"(N, H, W, C) input needs a (kh, kw, Cin, "
+                             f"Cout) kernel, got {k.shape}")
+        if k.shape[2] != d.dims[3]:
+            raise ValueError(f"kernel Cin {k.shape[2]} != input C "
+                             f"{d.dims[3]}")
+        hdim = 1
+    else:
+        raise ValueError(f"dconv2d expects a 2-D or 4-D (NHWC) DArray, "
+                         f"got ndim {d.ndim}")
+    hh = int(k.shape[0]) // 2
+
+    from .mapreduce import _even_shared_layout
+    grid = list(d.pids.shape)
+    sharded_dims = [i for i, g in enumerate(grid) if g > 1]
+    p = grid[hdim]
+    # communication-free dims may shard freely: N (pure data parallel);
+    # the height dim needs the halo; W/C sharding would need more
+    free_dims = {0, hdim} if d.ndim == 4 else {hdim}
+    eligible = (_even_shared_layout((d,))
+                and set(sharded_dims) <= free_dims
+                and (p == 1 or d.dims[hdim] // p >= hh))
+    if eligible:
+        name = d.sharding.spec[hdim]
+        if name is None or p == 1:
+            # height resident: zero-communication conv (GSPMD keeps any
+            # batch sharding — each rank convolves its own N slice)
+            res = jax.jit(_dense_conv)(d.garray, k)
+        else:
+            res = _conv_shm_jit(d.sharding.mesh, d.sharding.spec, name,
+                                hdim, hh)(d.garray, k)
+        # NHWC with Cout != C changes the trailing dim; _wrap_global
+        # re-derives the layout from the result shape over the same grid
+        return _wrap_global(res, procs=[int(q) for q in d.pids.flat],
+                            dist=grid)
+    from ..utils.debug import warn_once
+    warn_once(f"dconv2d-host-{tuple(grid)}-{d.ndim}",
+              f"dconv2d: layout (grid {tuple(grid)}) is not eligible for "
+              "the halo-exchange path (needs an even layout sharded only "
+              "along height, with the halo fitting the local block); "
+              "gathering to host for a dense conv")
+    res = np.asarray(_dense_conv(jnp.asarray(np.asarray(d)), k))
+    if res.shape == d.dims:
+        return darray_from_cuts(res, [int(q) for q in d.pids.flat], d.cuts)
+    return _wrap_global(jnp.asarray(res),
+                        procs=[int(q) for q in d.pids.flat], dist=grid)
