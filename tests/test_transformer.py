@@ -291,6 +291,7 @@ def test_sp_transformer_update_matches_dense_sgd(sp_setup):
                                               err_msg=jax.tree_util.keystr(k))
 
 
+@pytest.mark.slow
 def test_sp_transformer_optax_adamw(sp_setup):
     # real-optimizer training path: grads from the shard_map program,
     # Adam moments laid out by GSPMD to match each param (sharded FFN
@@ -313,6 +314,7 @@ def test_sp_transformer_optax_adamw(sp_setup):
     assert mu_w1.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_transformer_optax_adamw_sharded_moments():
     # GSPMD flagship with a real optimizer at the DEFAULT bf16 dtype:
     # the fp32 master-precision path must keep Adam-scale updates from
