@@ -1,0 +1,94 @@
+"""Render BENCH_DETAILS.json as ONE provenance-stamped markdown table.
+
+The bench evidence policy (docs/design.md "Performance notes") says
+numbers live in BENCH_DETAILS.json and docs must not restate absolutes
+that can drift from it; this tool is the presentation layer — run it
+after `python bench.py` on hardware and paste/compare its output instead
+of hand-copying values:
+
+    python tools/bench_report.py            # reads repo BENCH_DETAILS.json
+    python tools/bench_report.py path.json  # or any details file
+
+Groups entries by metric kind (TFLOPS/TOPS with MFU, GB/s, Gcell/s,
+seconds, tuned blocks), prints the provenance header, and LOUDLY lists
+any `*_IMPOSSIBLE_above_peak` flags and per-config `*_error` entries so
+a partial or miscalibrated run cannot be mistaken for a clean one.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def _fmt(v, nd=2):
+    return f"{v:,.{nd}f}" if isinstance(v, float) else str(v)
+
+
+def render(path: str) -> str:
+    d = json.loads(Path(path).read_text())
+    out = []
+    prov = d.get("_provenance")
+    if prov:
+        out.append("## Bench provenance\n")
+        for k, v in prov.items():
+            out.append(f"- **{k}**: {v}")
+    elif "devices" in d:
+        out.append(f"- **devices**: {d['devices']}")
+    if "_note" in d:
+        out.append(f"- **note**: {d['_note']}")
+
+    impossible = sorted(k for k in d if k.endswith("_IMPOSSIBLE_above_peak"))
+    errors = sorted(k for k in d if k.endswith("_error"))
+    if impossible:
+        out.append("\n## IMPOSSIBLE ENTRIES (measurement above chip peak "
+                   "— do not publish)\n")
+        out.extend(f"- `{k}`" for k in impossible)
+    if errors:
+        out.append("\n## Configs that errored\n")
+        out.extend(f"- `{k[:-6]}`: {str(d[k])[:120]}" for k in errors)
+
+    rows = []
+    for k in sorted(d):
+        if k.startswith("_") or k == "devices" or k.endswith(
+                ("_IMPOSSIBLE_above_peak", "_error", "_mfu")):
+            continue
+        v = d[k]
+        if k.endswith(("_tflops", "_tops")):
+            unit = "TFLOPS" if k.endswith("_tflops") else "TOPS"
+            base = k.rsplit("_", 1)[0]
+            mfu = d.get(base + "_mfu")
+            mfu_s = f"{100 * mfu:.1f}%" if isinstance(mfu, (int, float)) \
+                else "—"
+            rows.append((base, f"{_fmt(v)} {unit}", mfu_s))
+        elif k.endswith("_gflops"):
+            rows.append((k[:-7], f"{_fmt(v)} GFLOPS", "—"))
+        elif k.endswith(("_gbps", "_gcells_per_s")):
+            unit = "GB/s" if k.endswith("_gbps") else "Gcell/s"
+            rows.append((k, f"{_fmt(v)} {unit}", "—"))
+        elif k.endswith(("_s", "_s_per_iter", "_latency_s")):
+            rows.append((k, f"{_fmt(v, 6)} s", "—"))
+        elif k.endswith(("_block", "_speedup", "_L", "_attempts")):
+            rows.append((k, _fmt(v), "—"))
+        elif isinstance(v, dict):
+            best = max(v.items(), key=lambda kv: kv[1]) \
+                if all(isinstance(x, (int, float)) for x in v.values()) \
+                else None
+            rows.append((k, f"sweep of {len(v)}"
+                         + (f", best {best[0]} = {_fmt(best[1])}"
+                            if best else ""), "—"))
+        else:
+            rows.append((k, _fmt(v), "—"))
+
+    out.append("\n## Measurements\n")
+    out.append("| entry | value | MFU |")
+    out.append("|---|---|---|")
+    out.extend(f"| `{n}` | {v} | {m} |" for n, v, m in rows)
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    src = sys.argv[1] if len(sys.argv) > 1 else \
+        Path(__file__).resolve().parents[1] / "BENCH_DETAILS.json"
+    print(render(str(src)))
