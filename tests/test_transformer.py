@@ -296,7 +296,7 @@ def test_sp_transformer_optax_adamw(sp_setup):
     # real-optimizer training path: grads from the shard_map program,
     # Adam moments laid out by GSPMD to match each param (sharded FFN
     # moments stay sharded)
-    import optax
+    optax = pytest.importorskip("optax")
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
     tx = optax.adamw(3e-3)
     step, init = SPT.make_optax_train_step(mesh, cfg, tx)
@@ -320,7 +320,7 @@ def test_transformer_optax_adamw_sharded_moments():
     # the fp32 master-precision path must keep Adam-scale updates from
     # rounding away in bf16, moments must inherit the Megatron tp
     # sharding of their params, and training must converge
-    import optax
+    optax = pytest.importorskip("optax")
     cfg = T.Config(vocab=32, dim=64, heads=4, layers=2, max_seq=32)
     assert cfg.dtype == jnp.bfloat16
     mesh = make_mesh(8)
