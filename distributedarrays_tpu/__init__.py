@@ -29,7 +29,8 @@ from .ops.mapreduce import (dreduce, dmapreduce, dsum, dprod, dmaximum,
 from .ops.conv import dconv2d
 from .ops.fft import dfft, difft, dfft2, difft2
 from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
-                         rmul_diag, matmul, mul_into, dtranspose, dadjoint)
+                         rmul_diag, matmul, mul_into, dtranspose, dadjoint,
+                         tune_matmul_impl, tune_matmul_impl_dist)
 from .ops.sort import dsort
 from .ops.sparse import dnnz, ddata_bcoo
 from . import parallel

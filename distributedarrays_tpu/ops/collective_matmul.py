@@ -35,7 +35,8 @@ from jax import lax
 
 from ..parallel.collectives import pshift
 
-__all__ = ["allgather_matmul", "matmul_reducescatter", "tp_ffn"]
+__all__ = ["allgather_matmul", "allgather_matmul_rhs",
+           "matmul_reducescatter", "tp_ffn"]
 
 
 def allgather_matmul(x, w, axis: str):
@@ -73,6 +74,48 @@ def allgather_matmul(x, w, axis: str):
     src = (r + p - 1) % p
     return lax.dynamic_update_slice(out, (cur @ w).astype(out.dtype),
                                     (src * m_loc, 0))
+
+
+def allgather_matmul_rhs(a, b, axis: str):
+    """``a @ all_gather(b, axis)`` with the gather pipelined into the GEMM
+    — the RIGHT-operand twin of ``allgather_matmul``.
+
+    ``a``: this rank's resident ``(m_loc, k)`` row block of the left
+    operand (all k columns present); ``b``: this rank's ``(k_loc, n)``
+    row chunk of the gathered operand, ``k = p * k_loc``.  Returns
+    ``(m_loc, n)`` — rank r's row block of ``A @ B``.  This is the
+    contraction-sharded-B GEMM that a row-chunked ``DMatrix @ DMatrix``
+    produces (both operands on a (p,1) grid): plain GSPMD all-gathers B
+    then multiplies, serializing wire and MXU; here each resident chunk
+    multiplies the matching column slice of ``a`` while ``pshift``
+    fetches the next chunk.
+
+    Ring schedule: at step t the chunk originally from rank ``(r + t) %
+    p`` is resident and contracts against ``a[:, src*k_loc:(src+1)*
+    k_loc]``; p - 1 hops total.
+    """
+    p = lax.axis_size(axis)
+    out_dtype = jnp.result_type(a.dtype, b.dtype)
+    if p == 1:
+        return (a @ b).astype(out_dtype)
+    r = lax.axis_index(axis)
+    k_loc = b.shape[0]
+
+    def part(src, chunk):
+        return (lax.dynamic_slice_in_dim(a, src * k_loc, k_loc, 1)
+                @ chunk).astype(out_dtype)
+
+    def body(t, carry):
+        cur, acc = carry
+        src = (r + t) % p                   # chunk cur originated at src
+        nxt = pshift(cur, axis, -1)         # fetch rank r+1's chunk
+        return nxt, acc + part(src, cur)
+
+    # step 0's resident chunk seeds the accumulator (also keeps the loop
+    # carry varying over the mesh axis for shard_map's type system)
+    cur, acc = lax.fori_loop(1, p - 1, body,
+                             (pshift(b, axis, -1), part(r, b)))
+    return acc + part((r + p - 1) % p, cur)
 
 
 def matmul_reducescatter(x, w, axis: str):

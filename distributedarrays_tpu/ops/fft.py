@@ -11,8 +11,10 @@ the transform size.
 
 Eligibility for the compiled path: even layout, the array sharded on at
 most one dim, and every dim divisible by the shard count (all_to_all
-tiles evenly).  Anything else takes the host numpy path with the exact
-cut structure kept.
+tiles evenly).  A sharded DVector takes the four-step (Bailey)
+decomposition (``_fft1d_shm_jit``) when its length is divisible by p**2.
+Anything else takes the host numpy path with the exact cut structure
+kept.
 """
 
 from __future__ import annotations
@@ -61,6 +63,54 @@ def _fft_shm_jit(mesh, spec, ax: int, shard_dim: int, name: str,
                                  out_specs=spec))
 
 
+@functools.lru_cache(maxsize=128)
+def _fft1d_shm_jit(mesh, spec, name: str, n: int, p: int, inverse: bool):
+    """Distributed 1-D FFT of a block-sharded DVector as ONE shard_map
+    program: the four-step (Bailey) decomposition with n1 = p.  View the
+    length-``n`` vector as a row-major (p, n/p) matrix A — rank r's
+    local shard IS row r.  Then
+
+        1. length-p FFT down the columns (sharded dim) — all_to_all in,
+           local FFT, all_to_all back;
+        2. twiddle multiply by w_n^(k1*j2) (k1 = rank, local j2);
+        3. length-n/p FFT along the resident row;
+        4. transpose shuffle C.T.reshape(n) — one more all_to_all plus a
+           local transpose, landing each rank exactly its output block.
+
+    Inverse: conjugate twiddles + ifft in both steps (the two 1/len
+    normalizations compose to the required 1/n).  Three tiled
+    all_to_alls total; no host gather, no full-vector residency.
+    """
+    op = jnp.fft.ifft if inverse else jnp.fft.fft
+    from ..parallel.collectives import pall_to_all
+    n2 = n // p
+
+    def kernel(x):
+        ctype = jnp.result_type(x.dtype, jnp.complex64)
+        a = x.reshape(1, n2).astype(ctype)
+        # step 1: FFT of length p across the sharded dim
+        b = pall_to_all(a, name, split_dim=1, concat_dim=0)   # (p, n2/p)
+        b = op(b, axis=0)
+        b = pall_to_all(b, name, split_dim=0, concat_dim=1)   # (1, n2)
+        # step 2: twiddle — this rank now holds row k1 = rank.  The
+        # product k1*j2 < p*n2 = n stays int32-exact (eligibility caps
+        # n < 2**31); the f32 cast costs <= 2**-24 relative phase error,
+        # below complex64 resolution
+        k1 = jax.lax.axis_index(name)
+        j2 = jnp.arange(n2)
+        sign = 2j if inverse else -2j
+        tw = jnp.exp(sign * jnp.pi * (k1 * j2) / n).astype(ctype)
+        # step 3: resident-dim FFT of length n/p
+        c = op(b * tw, axis=1)                                # (1, n2)
+        # step 4: X[k2*p + k1] = C[k1, k2] — shuffle chunk r of every
+        # row onto rank r, local transpose, flatten
+        d_ = pall_to_all(c, name, split_dim=1, concat_dim=0)  # (p, n2/p)
+        return d_.T.reshape(n2)
+
+    return jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=spec,
+                                 out_specs=spec))
+
+
 def _fft_impl(d: DArray, ax: int, inverse: bool) -> DArray:
     if not isinstance(d, DArray):
         raise TypeError(f"expected DArray, got {type(d).__name__}")
@@ -77,7 +127,13 @@ def _fft_impl(d: DArray, ax: int, inverse: bool) -> DArray:
             # the already-evenly-cut ax dim) must divide p
             p = int(np.prod(d.pids.shape))
             if d.ndim == 1:
-                eligible = False      # no second dim to repartition onto
+                # four-step needs the local block (n/p) itself tileable
+                # p-ways by the internal all_to_alls: n % p**2 == 0.
+                # n < 2**31 keeps the twiddle product k1*j2 (< n by
+                # construction) exact in int32 — beyond that the phases
+                # would silently wrap
+                eligible = (d.dims[0] % (p * p) == 0
+                            and d.dims[0] < 2 ** 31)
             else:
                 other = next(i for i in range(d.ndim) if i != ax)
                 eligible = d.dims[other] % p == 0
@@ -85,31 +141,46 @@ def _fft_impl(d: DArray, ax: int, inverse: bool) -> DArray:
         eligible = False              # multi-dim grid
         shard_dim = None
     if eligible:
-        fn = _fft_shm_jit(d.sharding.mesh, d.sharding.spec, ax,
-                          -1 if shard_dim is None else shard_dim,
-                          "unused" if shard_dim is None
-                          else d.sharding.spec[shard_dim], inverse)
+        if d.ndim == 1 and shard_dim is not None and ax == shard_dim:
+            p = int(np.prod(d.pids.shape))
+            fn = _fft1d_shm_jit(d.sharding.mesh, d.sharding.spec,
+                                d.sharding.spec[0], int(d.dims[0]), p,
+                                inverse)
+        else:
+            fn = _fft_shm_jit(d.sharding.mesh, d.sharding.spec, ax,
+                              -1 if shard_dim is None else shard_dim,
+                              "unused" if shard_dim is None
+                              else d.sharding.spec[shard_dim], inverse)
         res = fn(d.garray)
         return _wrap_global(res, procs=[int(q) for q in d.pids.flat],
                             dist=list(d.pids.shape))
     # host path: exact cut structure kept, loud about the gather
     from ..utils.debug import warn_once
+    rule = ("a length divisible by p**2 for the four-step path"
+            if d.ndim == 1 else
+            "the repartition dim divisible by the shard count")
     warn_once(f"dfft-host-{d.pids.shape}-{d.ndim}-{ax}",
               f"dfft: layout (grid {tuple(d.pids.shape)}, dims {d.dims}, "
               f"axis {ax}) is not eligible for the compiled all_to_all "
-              "path (needs an even layout, a single sharded dim, and the "
-              "repartition dim divisible by the shard count); gathering "
-              "to host for a numpy FFT")
+              f"path (needs an even layout, a single sharded dim, and "
+              f"{rule}); gathering to host for a numpy FFT")
     full = np.asarray(d)
     out = (np.fft.ifft if inverse else np.fft.fft)(full, axis=ax)
-    return darray_from_cuts(out.astype(np.complex64),
+    # follow the input's complex promotion (complex128 only under x64),
+    # matching the compiled path's dtype instead of hard complex64
+    ctype = np.result_type(d.dtype, np.complex64)
+    if ctype == np.complex128 and not jax.config.jax_enable_x64:
+        ctype = np.complex64
+    return darray_from_cuts(out.astype(ctype),
                             [int(q) for q in d.pids.flat], d.cuts)
 
 
 def dfft(d: DArray, axis: int = -1) -> DArray:
-    """Distributed 1-D FFT along ``axis`` (complex64 result, same
-    layout).  A resident axis is one local ``jnp.fft.fft``; the sharded
-    axis costs two ``all_to_all`` repartitions around it."""
+    """Distributed 1-D FFT along ``axis`` (complex result, same
+    layout).  A resident axis is one local ``jnp.fft.fft``; a sharded
+    matrix axis costs two ``all_to_all`` repartitions around it; a
+    sharded DVector runs the four-step decomposition (three
+    all_to_alls) when ``len(d) % p**2 == 0``."""
     return _fft_impl(d, axis, inverse=False)
 
 

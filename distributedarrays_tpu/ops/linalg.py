@@ -34,7 +34,8 @@ from .broadcast import _unwrap, elementwise
 
 __all__ = [
     "axpy_", "ddot", "dnorm", "rmul_", "lmul_", "lmul_diag", "rmul_diag",
-    "matmul", "mul_into", "dtranspose", "dadjoint",
+    "matmul", "mul_into", "dtranspose", "dadjoint", "tune_matmul_impl",
+    "tune_matmul_impl_dist",
 ]
 
 
@@ -187,6 +188,187 @@ def _gemm_layout(A: DArray, B):
     return procs, (ra, cb)
 
 
+def _impl_key(*parts):
+    """Registry key for GEMM implementation choices: shape/dtype parts
+    PLUS the backend and device kind — a winner measured on one platform
+    (CPU dev box, v4, v5e...) must never drive dispatch on another, even
+    through a shared persisted cache."""
+    from ..utils import autotune
+    dev = jax.devices()[0]
+    return autotune.key_for(*parts, dev.platform, dev.device_kind)
+
+
+def _impl_choice(m, n, k, a_dtype, b_dtype):
+    """Consult the autotune registry for the GEMM implementation to use
+    for this shape: ``"pallas"`` (hand-owned Pallas schedule) or ``"jnp"``
+    (XLA).  Default is ``"jnp"`` — the owned schedules are promoted only
+    by a measured win banked by ``tune_matmul_impl`` / bench.py, never by
+    assumption (VERDICT round-3 item 4)."""
+    from ..utils import autotune
+    return autotune.get(
+        "matmul_impl", _impl_key(m, n, k, a_dtype, b_dtype)) or "jnp"
+
+
+def _try_pallas_gemm(av, bv, out_dtype):
+    """Single-device Pallas GEMM attempt; returns None when ineligible
+    (the caller falls back to the jnp path).  Eligibility: both operands
+    resident on ONE device (the autotuned kernel owns the whole GEMM — no
+    GSPMD partitioning to fight), float dtypes, an MXU-aligned tiling."""
+    if len(av.sharding.device_set) != 1 or len(bv.sharding.device_set) != 1:
+        return None
+    if not (jnp.issubdtype(av.dtype, jnp.floating)
+            and jnp.issubdtype(bv.dtype, jnp.floating)):
+        return None
+    from .pallas_gemm import pallas_matmul
+    try:
+        res = pallas_matmul(av, bv)
+    except ValueError:      # no aligned tiling for these shapes
+        return None
+    return res.astype(out_dtype)
+
+
+def _ring_ag_eligible(A: DArray, B, procs, dist):
+    """The 1-D TP shape the overlapped ring serves: A row-chunked on a
+    (p,1) grid, B contraction(row)-chunked on the SAME (p,1) rank list,
+    result row-chunked like A (which is both `_gemm_layout`'s allocation
+    and the mul_into cuts contract).  Plain GSPMD all-gathers B then
+    multiplies; `allgather_matmul_rhs` pipelines the gather into the
+    per-chunk matmuls over ICI."""
+    if not isinstance(B, DArray):
+        return False
+    p = A.pids.shape[0] if A.pids.ndim == 2 else 0
+    if p < 2 or A.pids.shape != (p, 1) or B.pids.shape != (p, 1):
+        return False
+    aprocs = [int(q) for q in A.pids.flat]
+    if [int(q) for q in B.pids.flat] != aprocs:
+        return False
+    if list(dist) != [p, 1] or [int(q) for q in procs[:p]] != aprocs:
+        return False
+    # even chunking everywhere the ring assumes it
+    m, k = A.dims
+    return m % p == 0 and k % p == 0 and not (A._padded or B._padded)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_ag_jit(procs, p, out_dtype_str):
+    """One shard_map program for the contraction-sharded-B GEMM: ring
+    all-gather of B pipelined into the per-chunk matmuls."""
+    from .collective_matmul import allgather_matmul_rhs
+    mesh = L.mesh_for(procs, (p,))
+    ax = mesh.axis_names[0]
+
+    def prog(a, b):
+        return allgather_matmul_rhs(a, b, ax).astype(out_dtype_str)
+
+    shm = jax.shard_map(prog, mesh=mesh,
+                        in_specs=(P(ax, None), P(ax, None)),
+                        out_specs=P(ax, None))
+    return mesh, ax, jax.jit(shm)
+
+
+def _ring_ag_gemm(A: DArray, B: DArray, out_dtype):
+    """Run the eligible TP GEMM as the overlapped ring program; returns
+    the (p,1)-row-sharded result array."""
+    p = A.pids.shape[0]
+    procs = tuple(int(q) for q in A.pids.flat)
+    mesh, ax, fn = _ring_ag_jit(procs, p, str(jnp.dtype(out_dtype)))
+    a = jax.device_put(A.garray, NamedSharding(mesh, P(ax, None)))
+    b = jax.device_put(B.garray, NamedSharding(mesh, P(ax, None)))
+    return fn(a, b)
+
+
+def _dist_impl_choice(m, n, k, p, a_dtype, b_dtype):
+    """Registry choice for the distributed GEMM: ``"ring_ag"`` (overlapped
+    ring) or ``"jnp"`` (GSPMD).  Default ``"jnp"`` — same promotion-by-
+    measurement policy as `_impl_choice` (XLA's own SPMD pass can overlap
+    too, so the ring must earn its place on the target topology); banked
+    by ``tune_matmul_impl_dist`` / bench.py."""
+    from ..utils import autotune
+    return autotune.get(
+        "matmul_impl_dist", _impl_key(m, n, k, p, a_dtype, b_dtype)) or "jnp"
+
+
+def _default_impl_timer(op, a, b):
+    """Best-of-3 wall clock with a scalar-fetch sync (block_until_ready
+    does not synchronize through every transport — see bench.py)."""
+    import time as _time
+    op(a, b)                                  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        float(jnp.sum(op(a, b)))              # scalar fetch = real sync
+        best = min(best, _time.perf_counter() - t0)
+    return best
+
+
+def _tune_impls(kernel, key, candidates, a, b, timer, persist):
+    """Shared promotion flow for the GEMM implementation tuners: time
+    every candidate (an impl whose timer raises scores inf — an invalid
+    tiling is an expected outcome), record the winner under ``kernel`` /
+    ``key``, optionally persist the registry.  ONE owner of the
+    record/persist contract for API and bench alike."""
+    from ..utils import autotune
+    results = {}
+    for name, op in candidates.items():
+        try:
+            results[name] = timer(op, a, b)
+        except Exception:
+            results[name] = float("inf")
+    winner = min(results, key=results.get)
+    autotune.record(kernel, key, winner)
+    if persist:
+        autotune.save_default()
+    return winner, results
+
+
+def tune_matmul_impl(m, n, k, dtype=jnp.float32, timer=None, persist=True):
+    """Measure ``jnp.matmul`` vs the Pallas schedule on THIS process's
+    default device for an (m,k)x(k,n) GEMM and bank the winner in the
+    autotune registry under ``matmul_impl`` (consulted by ``matmul`` /
+    ``DArray @ DArray``; the key includes the device kind, so a winner
+    from one platform never drives another).  ``timer(op, a, b) ->
+    seconds`` is injectable (bench.py passes its scan-chain t(L)/L
+    method; tests pass deterministic stubs).  Returns
+    ``(winner, {impl: seconds})``."""
+    from .pallas_gemm import pallas_matmul
+    a = jax.random.normal(jax.random.PRNGKey(0), (m, k),
+                          jnp.float32).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (k, n),
+                          jnp.float32).astype(dtype)
+    jfn = jax.jit(jnp.matmul)
+    return _tune_impls(
+        "matmul_impl", _impl_key(m, n, k, a.dtype, b.dtype),
+        {"jnp": jfn, "pallas": pallas_matmul}, a, b,
+        timer or _default_impl_timer, persist)
+
+
+def tune_matmul_impl_dist(m, n, k, p=None, dtype=jnp.float32, timer=None,
+                          persist=True):
+    """Measure GSPMD vs the overlapped ring (`allgather_matmul_rhs`) for
+    the 1-D TP GEMM — A row-chunked, B contraction-chunked over ``p``
+    devices — and bank the winner under ``matmul_impl_dist`` (consulted
+    by ``matmul`` for eligible (p,1)x(p,1) DArray operands).  ``p``
+    defaults to every local device; requires ``m % p == k % p == 0``."""
+    p = len(jax.devices()) if p is None else p
+    if p < 2:
+        raise ValueError("tune_matmul_impl_dist needs >= 2 devices")
+    if m % p or k % p:
+        raise ValueError(
+            f"m ({m}) and k ({k}) must be divisible by p ({p})")
+    procs = tuple(range(p))
+    mesh, ax, ring = _ring_ag_jit(procs, p, str(jnp.dtype(dtype)))
+    sh = NamedSharding(mesh, P(ax, None))
+    a = jax.device_put(jax.random.normal(
+        jax.random.PRNGKey(0), (m, k), jnp.float32).astype(dtype), sh)
+    b = jax.device_put(jax.random.normal(
+        jax.random.PRNGKey(1), (k, n), jnp.float32).astype(dtype), sh)
+    gspmd = jax.jit(jnp.matmul, out_shardings=sh)
+    return _tune_impls(
+        "matmul_impl_dist", _impl_key(m, n, k, p, a.dtype, b.dtype),
+        {"jnp": gspmd, "ring_ag": ring}, a, b,
+        timer or _default_impl_timer, persist)
+
+
 def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
     """C = alpha*A*B [+ beta*C] — distributed GEMM / matvec.
 
@@ -241,20 +423,38 @@ def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
             dist = list(dist)
         sharding = L.sharding_for(procs, dist, (m,) if vec else (m, n))
 
+    use_ab = not (alpha == 1.0 and beta == 0.0)
+    if beta != 0.0 and C is None:
+        raise ValueError("beta accumulation requires out=")
+    # plain-mode dispatch to the hand-owned schedules (VERDICT round-3
+    # item 4), each behind the autotune registry with jnp.matmul + GSPMD
+    # as the unconditional fallback: the overlapped ring for the 1-D TP
+    # shape, the Pallas kernel for single-device operands
+    if (not use_ab and not vec
+            and _ring_ag_eligible(A, B, procs, dist)
+            and _dist_impl_choice(m, n, k, A.pids.shape[0],
+                                  A.dtype, B.dtype) == "ring_ag"):
+        res = _ring_ag_gemm(A, B, out_dtype)
+        res = jax.device_put(res, sharding)
+        if C is not None:
+            C._rebind(res)
+            return C
+        return _wrap_global(res, procs=procs, dist=dist)
     from .broadcast import _align_devices
     av, bv = _align_devices([A.garray, bv], sharding)
-    use_ab = not (alpha == 1.0 and beta == 0.0)
     if use_ab and C is not None:
         res = _matmul_jit(sharding, "ab")(
             av, bv, C.garray,
             jnp.asarray(alpha, out_dtype), jnp.asarray(beta, out_dtype))
-    elif beta != 0.0:
-        raise ValueError("beta accumulation requires out=")
     elif alpha != 1.0:
         res = _matmul_jit(sharding, "alpha")(
             av, bv, jnp.asarray(alpha, out_dtype))
     else:
-        res = _matmul_jit(sharding, "plain")(av, bv)
+        res = None
+        if not vec and _impl_choice(m, n, k, av.dtype, bv.dtype) == "pallas":
+            res = _try_pallas_gemm(av, bv, out_dtype)
+        if res is None:
+            res = _matmul_jit(sharding, "plain")(av, bv)
     if res.dtype != out_dtype:
         res = res.astype(out_dtype)
     if C is not None:

@@ -186,6 +186,11 @@ def _binary_reduce(d, mapper, op, dims):
     except (jax.errors.JAXTypeError, TypeError):
         # op cannot trace (concretizes/branches on values): host fold.
         # Device-side failures (OOM, bad shapes) surface unmasked.
+        from ..utils.debug import warn_once
+        warn_once(f"dreduce-host-{getattr(op, '__name__', repr(op))}",
+                  f"dreduce: op {getattr(op, '__name__', repr(op))} "
+                  "cannot be jax-traced; gathering to host for a scalar "
+                  "left-fold")
         res = _binary_reduce_host(np.asarray(x), mapper, op, axes, ndim)
     if axes is None:
         return res
@@ -297,7 +302,15 @@ def _scan_impl(d: DArray, axis: int, kind: str) -> DArray:
         return _wrap_global(res, procs=[int(p) for p in d.pids.flat],
                             dist=list(d.pids.shape))
 
-    # uneven: host scan, exact cut structure kept (one device_put)
+    # uneven: host scan, exact cut structure kept (one device_put) —
+    # loud like every other documented degradation (one policy: a host
+    # gather is never silent, VERDICT round-3 item 6)
+    from ..utils.debug import warn_once
+    warn_once(f"dscan-host-{kind}-{d.pids.shape}-{tuple(d.dims)}",
+              f"d_cum{kind}: uneven layout (grid {tuple(d.pids.shape)}, "
+              f"dims {tuple(d.dims)}) is not eligible for the compiled "
+              "shard_map scan (needs an even layout); gathering to host "
+              "for a numpy scan")
     full = np.asarray(d)
     scanned = _SCAN_NP[kind](full, axis=ax)
     from ..darray import darray_from_cuts
@@ -526,6 +539,11 @@ def mapslices(f: Callable, d: DArray, dims) -> DArray:
         return _wrap_global(res, procs=[int(p) for p in d.pids.flat])
     except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError,
             TypeError):
+        from ..utils.debug import warn_once
+        warn_once(f"mapslices-host-{getattr(f, '__name__', repr(f))}",
+                  f"mapslices: {getattr(f, '__name__', repr(f))} cannot "
+                  "be jax-traced; gathering to host for a python slice "
+                  "loop")
         host = np.asarray(d)
         res = _np_mapslices(f, host, dims)
         return distribute(res, procs=[int(p) for p in d.pids.flat])

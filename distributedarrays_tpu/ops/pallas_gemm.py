@@ -200,7 +200,12 @@ def _int8_kernel(a_ref, b_ref, sa_ref, sb_ref, o_ref, acc_ref, *,
 
     @pl.when(pl.program_id(2) == k_steps - 1)
     def _flush():
-        scale = sa_ref[:] * sb_ref[:]            # (bm,1)*(1,bn) -> (bm,bn)
+        # scale refs arrive lane/sublane-aligned — (bm,128) and (8,bn),
+        # value replicated across the padding dims (Mosaic requires the
+        # minor block dim % 128, like the attention stats broadcast in
+        # pallas_attention) — slice one row/col back out for the outer
+        # product
+        scale = sa_ref[:, 0:1] * sb_ref[0:1, :]  # (bm,1)*(1,bn) -> (bm,bn)
         o_ref[:] = (acc_ref[:].astype(jnp.float32) * scale
                     ).astype(o_ref.dtype)
 
@@ -219,8 +224,8 @@ def _build_int8(m, n, k, bm, bn, bk, out_dtype_str, interpret):
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, s: (i, s)),
             pl.BlockSpec((bk, bn), lambda i, j, s: (s, j)),
-            pl.BlockSpec((bm, 1), lambda i, j, s: (i, 0)),
-            pl.BlockSpec((1, bn), lambda i, j, s: (0, j)),
+            pl.BlockSpec((bm, 128), lambda i, j, s: (i, 0)),
+            pl.BlockSpec((8, bn), lambda i, j, s: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, s: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.dtype(out_dtype_str)),
@@ -255,21 +260,29 @@ def pallas_matmul_int8(qa, qb, a_scale, b_scale,
         raise ValueError(f"matmul dim mismatch {qa.shape} @ {qb.shape}")
     if interpret is None:
         interpret = not _on_tpu()
-    if ka > (2**31 - 1) // (127 * 127):
+    safe_k = (2**31 - 1) // (127 * 127)
+    if ka > safe_k:
         # worst-case saturated operands overflow the int32 accumulator
-        # above this K; real data rarely saturates, so warn, don't refuse
+        # above this K; real data rarely saturates, so warn, don't refuse.
+        # Keyed on K so each risky contraction length surfaces once
+        # (a single process-wide key would hide later, larger K's).
         from ..utils.debug import warn_once
-        warn_once("pallas_matmul_int8_overflow",
+        warn_once(f"pallas_matmul_int8_overflow:{ka}",
                   f"pallas_matmul_int8: K={ka} exceeds the worst-case "
-                  "int32-exact bound (~133k); saturated operands may wrap. "
-                  "Split the contraction if inputs can saturate.")
+                  f"int32-exact bound (K <= {safe_k}); saturated operands "
+                  "may wrap. Split the contraction if inputs can saturate.")
     # int8 tiles are half the bytes of bf16, so the K cap doubles; int8
     # native MXU tiling wants the M block % 32
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul_int8",
         dtype_key=("int8",), caps=(1024, 1024, 1024), m_align=32)
-    sa = jnp.asarray(a_scale, jnp.float32).reshape(m, 1)
-    sb = jnp.asarray(b_scale, jnp.float32).reshape(1, n)
+    # lane/sublane-aligned scale carriers (see _int8_kernel flush): the
+    # replication costs m*512 + n*32 bytes of HBM — noise next to the
+    # int8 operands — and keeps every VMEM block Mosaic-legal
+    sa = jnp.broadcast_to(jnp.asarray(a_scale, jnp.float32).reshape(m, 1),
+                          (m, 128))
+    sb = jnp.broadcast_to(jnp.asarray(b_scale, jnp.float32).reshape(1, n),
+                          (8, n))
     fn = _build_int8(m, n, ka, bm, bn, bk, str(jnp.dtype(out_dtype)),
                      interpret)
     return fn(qa, qb, sa, sb)

@@ -135,6 +135,12 @@ def _psrs_sort(d: DArray, rev: bool, by=None, pivots_t=None) -> DArray:
         merged, nvalid = fn(d.garray_padded, vcounts)
     else:
         merged, nvalid = fn(d.garray_padded, vcounts, pivots_t)
+    if not getattr(merged.sharding, "is_fully_addressable", True):
+        # multi-controller: the SPMD program's output spans processes —
+        # assemble the (small) merged buffer via the DCN gather; every
+        # process then rebuilds the same layout (SPMD discipline)
+        from ..parallel.multihost import gather_global
+        merged, nvalid = gather_global(merged), gather_global(nvalid)
     merged = np.asarray(merged).reshape(p, p * mp)
     nvalid = np.asarray(nvalid).reshape(p)
     # reference rebuilds with the changed distribution and DROPS empty
@@ -225,11 +231,14 @@ def _key_minmax_jit(by):
     return jax.jit(fn)
 
 
-def _explicit_pivots(d: DArray, sample, by, by_ok, rev, p):
+def _explicit_pivots(d: DArray, sample, by, by_ok, rev, p,
+                     validate_only: bool = False):
     """Reference sample-strategy dispatch (sort.jl:110-135) → transformed
     pivot keys for the PSRS kernel, or None for ``sample=True``.  Raises
     on invalid values — the reference throws ArgumentError
-    (sort.jl:152-154); silently ignoring the knob is never an option."""
+    (sort.jl:152-154); silently ignoring the knob is never an option.
+    ``validate_only`` runs the value checks but skips the device work
+    (for paths where pivots only affect balance and are discarded)."""
     if sample is True:
         return None
     if not by_ok:
@@ -240,6 +249,8 @@ def _explicit_pivots(d: DArray, sample, by, by_ok, rev, p):
         jax.eval_shape(by, jax.ShapeDtypeStruct((1,), d.dtype)).dtype)
 
     if sample is False:
+        if validate_only:
+            return None      # always a valid strategy; skip the minmax pass
         # uniform assumption between the global key min/max (sort.jl:117-123)
         lo, hi = _key_minmax_jit(by)(d.garray)
         return _explicit_pivots(d, (float(lo), float(hi)), by, by_ok, rev, p)
@@ -256,6 +267,8 @@ def _explicit_pivots(d: DArray, sample, by, by_ok, rev, p):
         if np.isnan(part) or np.isinf(part):
             # reference: "lower and upper bounds must not be infinities"
             raise ValueError("sample bounds must be finite")
+        if validate_only:
+            return None
         vals = lo + np.arange(1, p) * part
         if np.issubdtype(key_dtype, np.integer):
             vals = np.round(vals)                    # sort.jl:138-141
@@ -272,6 +285,8 @@ def _explicit_pivots(d: DArray, sample, by, by_ok, rev, p):
             raise ValueError(
                 f"sample array needs >= {p} elements for {p} ranks, got "
                 f"{arr.size}")
+        if validate_only:
+            return None
         sv = jnp.asarray(arr.reshape(-1).astype(key_dtype, copy=False))
         kt, _ = _sort_keys(sv, key_dtype, rev)
         kt = jnp.sort(kt)
@@ -332,27 +347,44 @@ def dsort(d, sample=True, by=None, rev: bool = False, alg: str | None = None
             "psrs requires a traceable `by` (the given callable cannot be "
             "jax-traced; omit alg= to use the exact host sorted(key=by))")
     # sample-strategy dispatch runs (and VALIDATES) regardless of path
-    pivots_t = _explicit_pivots(d, sample, by, by_ok, rev, p) \
-        if eligible and by_ok else (
-            None if sample is True
-            else _reject_sample_off_psrs(sample))
+    if eligible and by_ok:
+        pivots_t = _explicit_pivots(d, sample, by, by_ok, rev, p)
+    elif sample is True:
+        pivots_t = None
+    elif by_ok:
+        # non-PSRS path (single rank / tiny array) with an explicit
+        # strategy: pivots only affect BALANCE, the sorted result is
+        # identical, and the reference accepts these calls — so validate
+        # the value (invalid still raises like sort.jl:152-154), then
+        # proceed with the global sort
+        _explicit_pivots(d, sample, by, by_ok, rev, p, validate_only=True)
+        pivots_t = None
+    else:
+        _reject_sample_off_psrs(sample)
     if by_ok and eligible and (alg == "psrs" or alg is None):
         return _psrs_sort(d, rev, by, pivots_t)
     if by_ok:
         res = _global_sort_jit(by, rev)(d.garray)
         return _wrap_global(res, procs=pids)
     # arbitrary Python `by` (reference sort.jl accepts any Julia
-    # callable): exact host sort, then redistribute
+    # callable): exact host sort, then redistribute — loud, like every
+    # documented host degradation
+    from ..utils.debug import warn_once
+    warn_once(f"dsort-host-{getattr(by, '__name__', repr(by))}",
+              f"dsort: `by` {getattr(by, '__name__', repr(by))} cannot "
+              "be jax-traced; gathering to host for an exact "
+              "sorted(key=by)")
     vals = list(np.asarray(d))
     vals.sort(key=by, reverse=rev)
     return distribute(np.asarray(vals, dtype=d.dtype), procs=pids)
 
 
 def _reject_sample_off_psrs(sample):
-    """Non-default ``sample`` strategies choose PSRS pivots; on paths with
-    no pivots (single rank / untraceable by) honoring them is impossible —
-    raise loudly rather than silently ignore (VERDICT round-2 item 4)."""
+    """Non-default ``sample`` strategies partition by the traced sort key;
+    with an untraceable Python ``by`` they can be neither honored nor
+    validated — raise loudly rather than silently ignore (VERDICT round-2
+    item 4; single-rank calls validate-and-proceed instead)."""
     raise ValueError(
-        f"sample={sample!r} selects a distributed pivot strategy, but this "
-        "sort cannot take the PSRS path (single rank, or untraceable "
-        "`by`); use sample=True")
+        f"sample={sample!r} selects a distributed pivot strategy, but the "
+        "given `by` cannot be jax-traced, so the strategy can be neither "
+        "applied nor validated; use sample=True")

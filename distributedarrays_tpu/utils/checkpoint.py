@@ -54,7 +54,17 @@ def _encode(tree, arrays: dict, copy: bool = False):
     materialize fresh host arrays and are never re-copied."""
     if isinstance(tree, DArray):
         key = f"a{len(arrays)}"
-        arrays[key] = np.asarray(tree)
+        # probe the at-rest physical buffer (`_data`), NOT `.garray` —
+        # for padded layouts garray runs the compiled unpad program, and
+        # the addressability answer is the same
+        if getattr(tree._data.sharding, "is_fully_addressable", True):
+            arrays[key] = np.asarray(tree)
+        else:
+            # multi-controller: the array spans processes — assemble via
+            # the DCN gather (every process calls save in SPMD style,
+            # like the reference's master-side checkpoint gather)
+            from ..parallel.multihost import gather_global
+            arrays[key] = gather_global(tree)
         return {"__dartpu__": "DArray", "key": key,
                 "procs": [int(p) for p in tree.pids.flat],
                 "dist": list(tree.pids.shape),

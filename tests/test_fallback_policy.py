@@ -1,0 +1,95 @@
+"""One fallback-visibility policy (VERDICT round-3 item 6): every
+documented degradation to a host gather emits a RuntimeWarning (once per
+site), and no compiled fast path warns.  The reference has no silent
+degradations to hide — its workers ARE the host; here a host gather
+abandons the device mesh, so it must always be visible."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.utils import debug as dbg
+
+
+@pytest.fixture(autouse=True)
+def fresh_warn_registry():
+    # warn_once keys are process-global; reset so each test sees its warning
+    with dbg._warned_lock:
+        saved = set(dbg._warned)
+        dbg._warned.clear()
+    yield
+    with dbg._warned_lock:
+        dbg._warned.clear()
+        dbg._warned.update(saved)
+    dat.d_closeall()
+
+
+def test_uneven_scan_warns(rng):
+    d = dat.distribute(rng.standard_normal(50).astype(np.float32),
+                       procs=range(4))
+    with pytest.warns(RuntimeWarning, match="gathering to host"):
+        got = dat.dcumsum(d)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.cumsum(np.asarray(d)), rtol=1e-4)
+
+
+def test_even_scan_does_not_warn(rng):
+    d = dat.distribute(rng.standard_normal(64).astype(np.float32),
+                       procs=range(4))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        dat.dcumsum(d)
+
+
+def test_untraceable_mapslices_warns(rng):
+    A = rng.standard_normal((8, 6)).astype(np.float32)
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))
+
+    def untraceable(row):
+        return np.sort(np.asarray(row))      # numpy concretizes the tracer
+
+    with pytest.warns(RuntimeWarning, match="cannot be jax-traced"):
+        got = dat.mapslices(untraceable, d, (1,))
+    np.testing.assert_allclose(np.asarray(got), np.sort(A, axis=1),
+                               rtol=1e-5)
+
+
+def test_untraceable_reduce_warns(rng):
+    d = dat.distribute(np.arange(16, dtype=np.float32))
+
+    def pyop(a, b):
+        return max(float(a), float(b))       # branches on concrete values
+
+    with pytest.warns(RuntimeWarning, match="cannot be jax-traced"):
+        got = dat.dreduce(pyop, d)
+    assert float(got) == 15.0
+
+
+def test_untraceable_sort_by_warns(rng):
+    x = rng.standard_normal(32).astype(np.float32)
+    d = dat.distribute(x)
+
+    def pyby(v):
+        return -float(v)                     # concretizes
+
+    with pytest.warns(RuntimeWarning, match="cannot be jax-traced"):
+        got = dat.dsort(d, by=pyby)
+    np.testing.assert_array_equal(np.asarray(got), np.sort(x)[::-1])
+
+
+def test_fft_conv_host_paths_warn(rng):
+    # pinned here as part of the one-policy audit (also covered in their
+    # own suites): dfft uneven and dconv2d multi-dim-grid host gathers
+    V = dat.distribute(rng.standard_normal(50).astype(np.float32),
+                       procs=range(4))
+    with pytest.warns(RuntimeWarning, match="gathering"):
+        dat.dfft(V)
+    A = dat.distribute(rng.standard_normal((16, 16)).astype(np.float32),
+                       procs=range(4), dist=(2, 2))
+    k = np.ones((3, 3), np.float32)
+    with pytest.warns(RuntimeWarning):
+        dat.dconv2d(A, k)

@@ -92,3 +92,43 @@ def test_dfft_resident_axis_non_divisible_stays_compiled(rng):
     np.testing.assert_allclose(got0, np.fft.fft(A, axis=0).astype(np.complex64),
                                rtol=1e-3, atol=1e-3)
     dat.d_closeall()
+
+
+def test_dfft_1d_compiled_four_step(rng):
+    # n % p**2 == 0 -> the four-step Bailey path, no host gather
+    import warnings
+    x = rng.standard_normal(256).astype(np.float32)
+    d = dat.distribute(x, procs=range(8))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        got = np.asarray(dat.dfft(d))
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-3, atol=1e-3)
+    # inverse path roundtrips with its own twiddles/normalization
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        back = dat.difft(dat.dfft(d))
+    np.testing.assert_allclose(np.asarray(back).real, x,
+                               rtol=1e-4, atol=1e-4)
+    assert back.cuts == d.cuts
+    dat.d_closeall()
+
+
+def test_dfft_1d_complex_input_compiled(rng):
+    z = (rng.standard_normal(128) + 1j * rng.standard_normal(128)) \
+        .astype(np.complex64)
+    d = dat.distribute(z, procs=range(4))
+    got = np.asarray(dat.dfft(d))
+    np.testing.assert_allclose(got, np.fft.fft(z).astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+    dat.d_closeall()
+
+
+def test_dfft_1d_not_p_squared_divisible_host_path(rng):
+    # even layout (72 % 8 == 0) but 72 % 64 != 0 -> loud host fallback
+    x = rng.standard_normal(72).astype(np.float32)
+    d = dat.distribute(x, procs=range(8))
+    with pytest.warns(RuntimeWarning, match="gathering"):
+        got = np.asarray(dat.dfft(d))
+    np.testing.assert_allclose(got, np.fft.fft(x).astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+    dat.d_closeall()

@@ -27,7 +27,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_jax_distributed():
+def _run_cluster(stage: str, timeout: int):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
@@ -35,7 +35,7 @@ def test_two_process_jax_distributed():
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
-        subprocess.Popen([sys.executable, _CHILD, str(port), str(i)],
+        subprocess.Popen([sys.executable, _CHILD, str(port), str(i), stage],
                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                          text=True, env=env)
         for i in range(2)
@@ -43,7 +43,7 @@ def test_two_process_jax_distributed():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -52,6 +52,23 @@ def test_two_process_jax_distributed():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert f"MULTIHOST_OK proc={i}" in out, out
+
+
+def test_two_process_smoke():
+    """Default-loop guard (<60 s): cluster formation + the core
+    cross-process DArray ops, so regressions in `_put_global`'s
+    process-spanning branches surface without DAT_TEST_SLOW=1
+    (VERDICT round-3 item 8)."""
+    _run_cluster("smoke", timeout=120)
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed():
+    """The full cross-process op matrix (the reference runs its entire
+    suite multi-process, runtests.jl:10-13): elementwise, reductions,
+    GEMM, uneven, scan, FFT, dsort, compiled run_spmd+pshift, checkpoint
+    round-trip, ring attention."""
+    _run_cluster("full", timeout=360)
 
 
 def test_initialize_no_cluster_degrades_to_single_process():

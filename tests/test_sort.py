@@ -321,13 +321,24 @@ def test_sample_invalid_values_raise(rng):
     dat.d_closeall()
 
 
-def test_sample_strategy_rejected_off_psrs_path(rng):
-    # a pivot strategy cannot be honored on a single-rank layout — loud
-    # error, never a silent ignore (VERDICT round-2 item 4)
+def test_sample_strategy_single_rank_validates_and_proceeds(rng):
+    # single rank: pivots only affect balance, the sorted result is
+    # identical, and the reference accepts these calls — valid strategies
+    # proceed (ADVICE round-3), INVALID values still raise
     x = rng.standard_normal(64).astype(np.float32)
     d1 = dat.distribute(x, procs=[0], dist=[1])
+    for sample in [(0.0, 1.0), False, np.sort(x)[::8]]:
+        got = dsort(dat.distribute(x, procs=[0], dist=[1]), sample=sample)
+        np.testing.assert_array_equal(np.asarray(got), np.sort(x))
+    with pytest.raises(ValueError, match="min <= max"):
+        dsort(d1, sample=(3.0, -3.0))
     with pytest.raises(ValueError, match="sample"):
-        dsort(d1, sample=(0.0, 1.0))
+        dsort(d1, sample="bogus")
+    # an untraceable Python `by` still cannot honor (or validate) an
+    # explicit strategy — loud error, never a silent ignore
+    d = dat.distribute(x)
+    with pytest.raises(ValueError, match="jax-traced"):
+        dsort(d, sample=(0.0, 1.0), by=lambda v: hash(v))
     dat.d_closeall()
 
 
