@@ -1,4 +1,4 @@
-"""Expert parallelism: top-1 mixture-of-experts with all_to_all dispatch.
+"""Expert parallelism: top-k mixture-of-experts with all_to_all dispatch.
 
 Completes the parallelism inventory (SURVEY.md §2: "EP absent in
 reference — all-to-all covers the communication substrate it needs").  The
@@ -8,9 +8,16 @@ Here the buckets are tokens routed to experts, the exchange is
 ``lax.all_to_all`` over the ``ep`` mesh axis, and the whole
 route→dispatch→FFN→return→combine path is ONE compiled shard_map program.
 
-Top-1 routing with a capacity limit: each rank sends at most ``capacity``
-tokens to each expert; overflowing tokens pass through on the residual
-path (standard Switch-style behavior).
+Routing (GShard/Switch-style):
+
+- top-``k`` experts per token, gates renormalized over the selected k;
+- per-(rank, expert) ``capacity`` slots, default ``ceil(capacity_factor *
+  k * n_local / E)`` — slot-major assignment so a token's primary expert
+  wins capacity before anyone's secondary;
+- tokens whose every slot overflowed pass through on the residual path;
+- the auxiliary load-balance loss ``E * Σ_e f_e · P_e`` (Switch eq. 4:
+  f_e = fraction of tokens whose top-1 is e, P_e = mean router prob),
+  psum-averaged over the expert axis, returned for the trainer to scale.
 """
 
 from __future__ import annotations
@@ -52,51 +59,85 @@ def _expert_ffn(x, W1, W2):
     return jax.nn.gelu(x @ W1) @ W2
 
 
-def _route(x, Wg, n_experts, capacity):
-    """Top-1 routing with per-(rank, expert) capacity; returns expert id,
-    gate prob, bucket position, and keep mask per local token."""
+def _route_topk(x, Wg, n_experts, k, capacity):
+    """Top-k routing with per-(rank, expert) capacity.
+
+    Returns per-token/slot expert ids (n, k), renormalized gates (n, k),
+    capacity positions (n, k), keep masks (n, k), and the Switch aux-loss
+    ingredients (f_e, P_e) over the local tokens.  Slot-major position
+    assignment: ALL slot-0 (primary) picks claim capacity before any
+    slot-1 pick, mirroring GShard's priority."""
+    n = x.shape[0]
     logits = x @ Wg                                     # (n, E)
-    e = jnp.argmax(logits, axis=-1)                     # (n,)
-    p = jax.nn.softmax(logits, axis=-1)[jnp.arange(x.shape[0]), e]
-    onehot = jax.nn.one_hot(e, n_experts, dtype=jnp.int32)
-    pos = (jnp.cumsum(onehot, axis=0) - 1)[jnp.arange(x.shape[0]), e]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, eidx = lax.top_k(probs, k)                    # (n, k)
+    if k > 1:
+        # GShard: renormalize over the selected k.  Top-1 (Switch) keeps
+        # the RAW router prob — it is the router's gradient path.
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+    flat_e = eidx.T.reshape(-1)                         # (k*n,) slot-major
+    onehot = jax.nn.one_hot(flat_e, n_experts, dtype=jnp.int32)
+    pos = (jnp.cumsum(onehot, axis=0) - 1)[
+        jnp.arange(k * n), flat_e].reshape(k, n).T      # (n, k)
     keep = pos < capacity
-    return e, p, pos, keep
+    # Switch aux ingredients over the local shard: f_e from the top-1
+    # assignment, P_e the mean router prob
+    f_e = jnp.mean(jax.nn.one_hot(eidx[:, 0], n_experts,
+                                  dtype=probs.dtype), axis=0)
+    P_e = jnp.mean(probs, axis=0)
+    return eidx, gate, pos, keep, f_e, P_e
 
 
 @functools.lru_cache(maxsize=32)
-def _moe_jit(mesh, capacity: int):
+def _moe_jit(mesh, capacity: int, k: int):
     axis = mesh.axis_names[0]
     E = mesh.shape[axis]
 
     def kernel(x, Wg, W1, W2):
         # x: (n, H) local tokens; W1/W2: (1, H, F)/(1, F, H) local expert
         n, H = x.shape
-        e, p, pos, keep = _route(x, Wg, E, capacity)
+        eidx, gate, pos, keep, f_e, P_e = _route_topk(x, Wg, E, k, capacity)
         posc = jnp.clip(pos, 0, capacity - 1)
-        # dispatch buffer: (E, C, H); dropped tokens contribute zeros
+        # dispatch buffer: (E, C, H); dropped slots contribute zeros
         buf = jnp.zeros((E, capacity, H), x.dtype)
-        buf = buf.at[e, posc].add(x * keep[:, None])
+        for j in range(k):                               # k is small/static
+            buf = buf.at[eidx[:, j], posc[:, j]].add(
+                x * keep[:, j, None].astype(x.dtype))
         recv = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
                               tiled=True)               # (E, C, H)
         y = _expert_ffn(recv.reshape(E * capacity, H), W1[0], W2[0])
         back = lax.all_to_all(y.reshape(E, capacity, H), axis,
                               split_axis=0, concat_axis=0, tiled=True)
-        yi = back[e, posc]                              # (n, H)
-        # combine: gated expert output for kept tokens, residual passthrough
-        # for capacity overflow
-        return jnp.where(keep[:, None], p[:, None] * yi, x)
+        # combine: gated sum over kept slots; residual passthrough only
+        # when EVERY slot of a token overflowed
+        out = jnp.zeros_like(x)
+        for j in range(k):
+            yi = back[eidx[:, j], posc[:, j]]           # (n, H)
+            out = out + jnp.where(keep[:, j, None],
+                                  gate[:, j, None] * yi, 0.0)
+        any_kept = jnp.any(keep, axis=-1)
+        out = jnp.where(any_kept[:, None], out, x)
+        # Switch aux loss, averaged over the expert-parallel ranks
+        aux = E * jnp.sum(f_e * P_e)
+        aux = lax.psum(aux, axis) / E
+        return out, aux
 
     return run_spmd(
         kernel, mesh,
         in_specs=(P(axis, None), P(), P(axis, None, None),
                   P(axis, None, None)),
-        out_specs=P(axis, None))
+        out_specs=(P(axis, None), P()))
 
 
-def moe_forward(params, x, mesh: Mesh, capacity: int | None = None):
+def moe_forward(params, x, mesh: Mesh, capacity: int | None = None,
+                k: int = 1, capacity_factor: float = 2.0,
+                return_aux: bool = False):
     """Route the (N, H) token-sharded batch through the expert-parallel
-    layer; returns (N, H) with the same sharding."""
+    layer; returns (N, H) with the same sharding (and the scalar
+    load-balance aux loss when ``return_aux``).
+
+    ``capacity`` (per rank per expert) defaults to
+    ``ceil(capacity_factor * k * n_local / E)``."""
     x = jnp.asarray(x)
     E = mesh.shape[mesh.axis_names[0]]
     if params["W1"].shape[0] != E:
@@ -105,36 +146,55 @@ def moe_forward(params, x, mesh: Mesh, capacity: int | None = None):
     if x.shape[0] % E:
         raise ValueError(f"token count {x.shape[0]} must be divisible by "
                          f"the {E} expert ranks")
+    if not 1 <= k <= E:
+        raise ValueError(f"k must be in [1, {E}], got {k}")
     n_local = x.shape[0] // E
     if capacity is None:
-        capacity = max(1, int(np.ceil(2.0 * n_local / E)))
+        capacity = max(1, int(np.ceil(capacity_factor * k * n_local / E)))
     if capacity <= 0:
         raise ValueError(f"capacity must be positive, got {capacity}")
-    return _moe_jit(mesh, int(capacity))(
+    out, aux = _moe_jit(mesh, int(capacity), int(k))(
         x, params["Wg"], params["W1"], params["W2"])
+    return (out, aux) if return_aux else out
 
 
-def reference_moe(params, x, capacity_per_rank_expert: int, n_ranks: int):
-    """Dense oracle replicating the routing + capacity semantics."""
+def reference_moe(params, x, capacity_per_rank_expert: int, n_ranks: int,
+                  k: int = 1):
+    """Dense oracle replicating the top-k routing + slot-major capacity
+    semantics."""
     x = np.asarray(x, np.float32)
     E = params["Wg"].shape[1]
-    out = np.empty_like(x)
+    out = np.zeros_like(x)
     n_local = x.shape[0] // n_ranks
     for r in range(n_ranks):
         xs = x[r * n_local:(r + 1) * n_local]
         logits = xs @ np.asarray(params["Wg"])
-        e = np.argmax(logits, axis=-1)
         pz = np.exp(logits - logits.max(-1, keepdims=True))
         pz = pz / pz.sum(-1, keepdims=True)
-        counts = {k: 0 for k in range(E)}
+        top = np.argsort(-pz, axis=-1, kind="stable")[:, :k]   # (n, k)
+        gates = np.take_along_axis(pz, top, axis=-1)
+        if k > 1:
+            gates = gates / gates.sum(-1, keepdims=True)
+        counts = {e: 0 for e in range(E)}
+        kept = np.zeros((n_local, k), bool)
+        for j in range(k):                       # slot-major priority
+            for i in range(n_local):
+                ei = int(top[i, j])
+                if counts[ei] < capacity_per_rank_expert:
+                    counts[ei] += 1
+                    kept[i, j] = True
         for i in range(n_local):
-            ei = int(e[i])
-            if counts[ei] < capacity_per_rank_expert:
-                counts[ei] += 1
-                h = np.asarray(_expert_ffn(jnp.asarray(xs[i:i + 1]),
-                                           jnp.asarray(params["W1"][ei]),
-                                           jnp.asarray(params["W2"][ei])))
-                out[r * n_local + i] = pz[i, ei] * h[0]
-            else:
+            if not kept[i].any():
                 out[r * n_local + i] = xs[i]
+                continue
+            acc = np.zeros(x.shape[1], np.float32)
+            for j in range(k):
+                if kept[i, j]:
+                    ei = int(top[i, j])
+                    h = np.asarray(_expert_ffn(
+                        jnp.asarray(xs[i:i + 1]),
+                        jnp.asarray(params["W1"][ei]),
+                        jnp.asarray(params["W2"][ei])))[0]
+                    acc += gates[i, j] * h
+            out[r * n_local + i] = acc
     return out

@@ -1,17 +1,30 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+"""Pipeline parallelism: microbatch pipelining over a mesh axis.
 
 The reference's parallelism inventory stops at data/tensor/ring patterns
 (SURVEY.md §2 "DP/PP/EP: absent in reference — ring/halo + all-to-all
 cover the communication substrate they'd need").  This module builds PP on
 that substrate: each mesh rank along the ``pp`` axis owns one pipeline
-stage's weights; activations flow stage-to-stage with ``lax.ppermute``
-(the same neighbor shift as the halo exchange), and the whole
-fill-steady-drain schedule is one ``lax.fori_loop`` inside ONE compiled
-shard_map program — no per-tick dispatch, no host in the loop.
+stage's weights (a stack of ``n_layers`` dense layers); activations flow
+stage-to-stage with ``lax.ppermute`` (the same neighbor shift as the halo
+exchange), and the whole schedule is one ``lax.fori_loop`` inside ONE
+compiled shard_map program — no per-tick dispatch, no host in the loop.
 
-Schedule: with P stages and M microbatches, T = M + P - 1 ticks; at tick
-``t`` stage ``s`` processes microbatch ``t - s`` (bubble ticks compute on
-zeros and are masked out of the output).
+Two training schedules:
+
+- ``pipeline_train_step`` — GPipe: autodiff through the fill-steady-drain
+  forward (XLA saves per-tick residuals; activation memory grows with the
+  microbatch count M).
+- ``pipeline_train_step_1f1b`` — 1F1B: a hand-scheduled
+  one-forward-one-backward interleave with per-stage ``jax.vjp``
+  recomputation.  Activation memory is bounded by ``min(M, 2P-1)`` saved
+  stage INPUTS per stage regardless of M (the 1F1B property); gradients
+  are exactly the GPipe/sequential gradients (tests pin this).
+
+Forward schedule: with P stages and M microbatches, stage ``s`` runs
+microbatch ``t - s`` at tick ``t`` (bubble ticks compute on zeros and are
+masked).  1F1B adds the backward wave: stage ``s`` runs the backward of
+microbatch ``t - (2P - 2 - s)``, so gradients counterflow on the same
+ring, and the loop closes after ``M + 2P - 2`` ticks.
 """
 
 from __future__ import annotations
@@ -27,7 +40,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.collectives import run_spmd, spmd_mesh
 
-__all__ = ["pipeline_forward", "pipeline_train_step", "init_pipeline_params",
+__all__ = ["pipeline_forward", "pipeline_train_step",
+           "pipeline_train_step_1f1b", "init_pipeline_params",
            "make_pp_mesh", "reference_forward"]
 
 
@@ -36,19 +50,35 @@ def make_pp_mesh(n_stages: int, axis: str = "pp") -> Mesh:
 
 
 def init_pipeline_params(key, n_stages: int, hidden: int,
-                         dtype=jnp.float32):
-    """One (hidden, hidden) layer + bias per stage, stacked on a leading
-    stage axis so the stack shards P('pp', ...)."""
-    keys = jax.random.split(key, n_stages)
+                         n_layers: int = 1, dtype=jnp.float32):
+    """``n_layers`` (hidden, hidden) dense layers + biases per stage,
+    stacked on a leading stage axis so the stacks shard P('pp', ...)."""
+    keys = jax.random.split(key, n_stages * n_layers)
+    sc = jnp.asarray(np.sqrt(1.0 / hidden), dtype)
     W = jnp.stack([
-        jax.random.normal(k, (hidden, hidden), dtype) *
-        jnp.asarray(np.sqrt(1.0 / hidden), dtype) for k in keys])
-    b = jnp.zeros((n_stages, hidden), dtype)
+        jnp.stack([jax.random.normal(keys[s * n_layers + l],
+                                     (hidden, hidden), dtype) * sc
+                   for l in range(n_layers)])
+        for s in range(n_stages)])                     # (S, L, H, H)
+    b = jnp.zeros((n_stages, n_layers, hidden), dtype)
     return {"W": W, "b": b}
 
 
-def _stage_fn(x, W, b):
-    return jax.nn.gelu(x @ W + b)
+def _norm_params(params):
+    """Accept the pre-multi-layer (S, H, H) weight shape as L=1."""
+    W, b = params["W"], params["b"]
+    if W.ndim == 3:
+        W, b = W[:, None], b[:, None]
+    return W, b
+
+
+def _stage_fn(x, Ws, bs):
+    """One stage: ``n_layers`` gelu-dense layers, scanned (Ws: (L, H, H))."""
+    def layer(h, wb):
+        W, b = wb
+        return jax.nn.gelu(h @ W + b), None
+    h, _ = lax.scan(layer, x, (Ws, bs))
+    return h
 
 
 @functools.lru_cache(maxsize=32)
@@ -59,7 +89,7 @@ def _pipeline_jit(mesh):
 
     def kernel(mb, W, b):
         # mb: (M, B, H) full microbatch stack (replicated);
-        # W: (1, H, H), b: (1, H): this stage's weights
+        # W: (1, L, H, H), b: (1, L, H): this stage's weights
         me = lax.axis_index(axis)
         Ws, bs = W[0], b[0]
         M, B, H = mb.shape
@@ -92,7 +122,7 @@ def _pipeline_jit(mesh):
 
     return run_spmd(
         kernel, mesh,
-        in_specs=(P(), P(axis, None, None), P(axis, None)),
+        in_specs=(P(), P(axis, None, None, None), P(axis, None, None)),
         out_specs=P())
 
 
@@ -102,42 +132,147 @@ def pipeline_forward(params, mb, mesh: Mesh):
     mb = jnp.asarray(mb)
     if mb.ndim != 3:
         raise ValueError(f"microbatches must be (M, B, H), got {mb.shape}")
+    W, b = _norm_params(params)
     nstg = mesh.shape[mesh.axis_names[0]]
-    if params["W"].shape[0] != nstg:
+    if W.shape[0] != nstg:
         raise ValueError(
-            f"params have {params['W'].shape[0]} stages, mesh has {nstg}")
-    return _pipeline_jit(mesh)(mb, params["W"], params["b"])
+            f"params have {W.shape[0]} stages, mesh has {nstg}")
+    return _pipeline_jit(mesh)(mb, W, b)
 
 
 @functools.lru_cache(maxsize=32)
 def _train_jit(mesh):
     fwd = _pipeline_jit(mesh)
 
-    def loss_fn(params, mb, tgt):
-        out = fwd(mb, params["W"], params["b"])
+    def loss_fn(wb, mb, tgt):
+        out = fwd(mb, wb[0], wb[1])
         return jnp.mean(jnp.square(out - tgt))
 
-    def step(params, mb, tgt, lr):
+    def step(wb, mb, tgt, lr):
         # lr rides as a traced scalar so schedules don't recompile
-        loss, g = jax.value_and_grad(loss_fn)(params, mb, tgt)
-        new = jax.tree_util.tree_map(lambda p, gg: p - lr * gg, params, g)
+        loss, g = jax.value_and_grad(loss_fn)(wb, mb, tgt)
+        new = jax.tree_util.tree_map(lambda p, gg: p - lr * gg, wb, g)
         return new, loss
 
     return jax.jit(step)
 
 
 def pipeline_train_step(params, mb, tgt, mesh: Mesh, lr: float = 1e-2):
-    """One SGD step through the pipeline: the backward pass re-traverses the
+    """One SGD step, GPipe schedule: the backward pass re-traverses the
     schedule in reverse (ppermute transposes to the opposite shift), all
     inside the same compiled program.  Gradients match the sequential model
     exactly (see tests)."""
-    return _train_jit(mesh)(params, jnp.asarray(mb), jnp.asarray(tgt),
-                            jnp.float32(lr))
+    W, b = _norm_params(params)
+    (W2, b2), loss = _train_jit(mesh)(
+        (W, b), jnp.asarray(mb), jnp.asarray(tgt), jnp.float32(lr))
+    if params["W"].ndim == 3:
+        W2, b2 = W2[:, 0], b2[:, 0]
+    return {"W": W2, "b": b2}, loss
+
+
+@functools.lru_cache(maxsize=32)
+def _train_1f1b_jit(mesh):
+    axis = mesh.axis_names[0]
+    nstg = mesh.shape[axis]
+
+    def kernel(mb, tgt, W, b):
+        # mb/tgt: (M, B, H) replicated; W: (1, L, H, H); b: (1, L, H)
+        me = lax.axis_index(axis)
+        Ws, bs = W[0], b[0]
+        M, B, H = mb.shape
+        S = min(M, 2 * nstg - 1)        # ring slots: the 1F1B memory bound
+        T = M + 2 * nstg - 2
+        fwd_perm = [(i, i + 1) for i in range(nstg - 1)]
+        bwd_perm = [(i + 1, i) for i in range(nstg - 1)]
+        denom = jnp.asarray(1.0 / (M * B * H), jnp.float32)
+
+        def tick(t, carry):
+            recv_x, recv_g, saved, dW, db, loss_acc = carry
+
+            # ---- forward half: stage `me` runs microbatch t - me -------
+            mf = t - me
+            f_valid = (mf >= 0) & (mf < M)
+            mb_t = lax.dynamic_index_in_dim(
+                mb, jnp.clip(mf, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(me == 0, mb_t, recv_x)
+            x_in = jnp.where(f_valid, x_in, jnp.zeros_like(x_in))
+            y = _stage_fn(x_in, Ws, bs)
+            # bank this microbatch's stage INPUT for its backward; ring
+            # slot mf % S (collision-free: <= 2P-1 in flight per stage).
+            # Invalid ticks must not clobber a live slot.
+            slot = jnp.clip(mf, 0, M - 1) % S
+            cur = lax.dynamic_index_in_dim(saved, slot, 0, keepdims=False)
+            saved = lax.dynamic_update_index_in_dim(
+                saved, jnp.where(f_valid, x_in, cur), slot, 0)
+
+            # ---- backward half: microbatch t - (2P - 2 - me) -----------
+            mk = t - (2 * nstg - 2 - me)
+            b_valid = (mk >= 0) & (mk < M)
+            bslot = jnp.clip(mk, 0, M - 1) % S
+            x_save = lax.dynamic_index_in_dim(saved, bslot, 0,
+                                              keepdims=False)
+            # recompute the stage forward for residuals (rematerialize)
+            y2, vjp = jax.vjp(_stage_fn, x_save, Ws, bs)
+            tgt_b = lax.dynamic_index_in_dim(
+                tgt, jnp.clip(mk, 0, M - 1), 0, keepdims=False)
+            # loss = (1/M) sum_m mean_{B,H} (y_m - tgt_m)^2  — identical
+            # to the GPipe step's jnp.mean over (M, B, H)
+            dy_last = (2.0 * (y2 - tgt_b) * denom).astype(y2.dtype)
+            dy = jnp.where(me == nstg - 1, dy_last, recv_g)
+            dy = jnp.where(b_valid, dy, jnp.zeros_like(dy))
+            dx, dWs, dbs = vjp(dy)
+            dW = dW + dWs
+            db = db + dbs
+            loss_acc = loss_acc + jnp.where(
+                b_valid & (me == nstg - 1),
+                jnp.sum(jnp.square(y2 - tgt_b)) * denom, 0.0)
+
+            # ---- ring sends: activation down, cotangent up -------------
+            recv_x = lax.ppermute(
+                jnp.where(f_valid, y, jnp.zeros_like(y)), axis, fwd_perm)
+            recv_g = lax.ppermute(dx, axis, bwd_perm)
+            return recv_x, recv_g, saved, dW, db, loss_acc
+
+        z = jnp.zeros((B, H), mb.dtype)
+        init = (z, z, jnp.zeros((S, B, H), mb.dtype),
+                jnp.zeros_like(Ws), jnp.zeros_like(bs),
+                jnp.float32(0.0))
+        _, _, _, dW, db, loss = lax.fori_loop(0, T, tick, init)
+        # loss lives on the last stage only; grads are per-stage shards
+        return dW[None], db[None], lax.psum(loss, axis)
+
+    grad_fn = run_spmd(
+        kernel, mesh,
+        in_specs=(P(), P(), P(axis, None, None, None), P(axis, None, None)),
+        out_specs=(P(axis, None, None, None), P(axis, None, None), P()))
+
+    def step(W, b, mb, tgt, lr):
+        dW, db, loss = grad_fn(mb, tgt, W, b)
+        return W - lr * dW, b - lr * db, loss
+
+    return jax.jit(step)
+
+
+def pipeline_train_step_1f1b(params, mb, tgt, mesh: Mesh, lr: float = 1e-2):
+    """One SGD step under the hand-scheduled 1F1B interleave.
+
+    Same gradients and loss as ``pipeline_train_step`` (pinned by tests),
+    but each stage saves at most ``min(M, 2P-1)`` microbatch inputs and
+    rematerializes its forward in the backward half — activation memory is
+    bounded by the pipeline depth, not the microbatch count, which is the
+    reason 1F1B exists."""
+    W, b = _norm_params(params)
+    W2, b2, loss = _train_1f1b_jit(mesh)(
+        W, b, jnp.asarray(mb), jnp.asarray(tgt), jnp.float32(lr))
+    if params["W"].ndim == 3:
+        W2, b2 = W2[:, 0], b2[:, 0]
+    return {"W": W2, "b": b2}, loss
 
 
 def reference_forward(params, mb):
-    """Sequential oracle: apply every stage in order."""
+    """Sequential oracle: apply every stage (and its layers) in order."""
+    W, b = _norm_params(params)
     x = jnp.asarray(mb)
-    for s in range(params["W"].shape[0]):
-        x = _stage_fn(x, params["W"][s], params["b"][s])
+    for s in range(W.shape[0]):
+        x = _stage_fn(x, W[s], b[s])
     return x

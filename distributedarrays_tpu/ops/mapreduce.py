@@ -91,6 +91,19 @@ def _jitted_by_key(fn):
     return jax.jit(fn)
 
 
+def _fn_site(fn):
+    """Callable identifier for host-fallback warn keys: name plus the
+    definition site, so two different lambdas (both named ``<lambda>``)
+    never share one warn_once key and each degradation site surfaces."""
+    import os as _os
+    name = getattr(fn, "__name__", None) or repr(fn)
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        return (f"{name}@{_os.path.basename(code.co_filename)}:"
+                f"{code.co_firstlineno}")
+    return name
+
+
 def _fit_dist(shape, dist):
     return [min(c, s) if s > 0 else 1 for c, s in zip(dist, shape)]
 
@@ -187,8 +200,8 @@ def _binary_reduce(d, mapper, op, dims):
         # op cannot trace (concretizes/branches on values): host fold.
         # Device-side failures (OOM, bad shapes) surface unmasked.
         from ..utils.debug import warn_once
-        warn_once(f"dreduce-host-{getattr(op, '__name__', repr(op))}",
-                  f"dreduce: op {getattr(op, '__name__', repr(op))} "
+        warn_once(f"dreduce-host-{_fn_site(op)}",
+                  f"dreduce: op {_fn_site(op)} "
                   "cannot be jax-traced; gathering to host for a scalar "
                   "left-fold")
         res = _binary_reduce_host(np.asarray(x), mapper, op, axes, ndim)
@@ -540,8 +553,8 @@ def mapslices(f: Callable, d: DArray, dims) -> DArray:
     except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError,
             TypeError):
         from ..utils.debug import warn_once
-        warn_once(f"mapslices-host-{getattr(f, '__name__', repr(f))}",
-                  f"mapslices: {getattr(f, '__name__', repr(f))} cannot "
+        warn_once(f"mapslices-host-{_fn_site(f)}",
+                  f"mapslices: {_fn_site(f)} cannot "
                   "be jax-traced; gathering to host for a python slice "
                   "loop")
         host = np.asarray(d)

@@ -370,8 +370,9 @@ def dsort(d, sample=True, by=None, rev: bool = False, alg: str | None = None
     # callable): exact host sort, then redistribute — loud, like every
     # documented host degradation
     from ..utils.debug import warn_once
-    warn_once(f"dsort-host-{getattr(by, '__name__', repr(by))}",
-              f"dsort: `by` {getattr(by, '__name__', repr(by))} cannot "
+    from .mapreduce import _fn_site
+    warn_once(f"dsort-host-{_fn_site(by)}",
+              f"dsort: `by` {_fn_site(by)} cannot "
               "be jax-traced; gathering to host for an exact "
               "sorted(key=by)")
     vals = list(np.asarray(d))

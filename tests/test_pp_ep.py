@@ -102,3 +102,129 @@ def test_moe_validation():
         M.moe_forward(params4, jnp.zeros((9, 16)), mesh)
     with pytest.raises(ValueError, match="capacity"):
         M.moe_forward(params4, jnp.zeros((8, 16)), mesh, capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# round-4: multi-layer stages + the 1F1B schedule (VERDICT round-3 item 9)
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_multilayer_stages_match_sequential():
+    mesh = PP.make_pp_mesh(4)
+    params = PP.init_pipeline_params(jax.random.key(5), 4, 16, n_layers=3)
+    assert params["W"].shape == (4, 3, 16, 16)
+    mb = jax.random.normal(jax.random.key(6), (5, 2, 16))
+    got = PP.pipeline_forward(params, mb, mesh)
+    want = PP.reference_forward(params, mb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_1f1b_matches_gpipe_gradients():
+    # the 1F1B hand-scheduled backward must produce EXACTLY the GPipe /
+    # sequential gradients (same loss, same updated params)
+    mesh = PP.make_pp_mesh(4)
+    params = PP.init_pipeline_params(jax.random.key(0), 4, 16, n_layers=2)
+    mb = jax.random.normal(jax.random.key(1), (6, 3, 16))
+    tgt = jax.random.normal(jax.random.key(2), (6, 3, 16))
+    p_gpipe, loss_g = PP.pipeline_train_step(params, mb, tgt, mesh, lr=0.05)
+    p_1f1b, loss_f = PP.pipeline_train_step_1f1b(params, mb, tgt, mesh,
+                                                 lr=0.05)
+    np.testing.assert_allclose(float(loss_f), float(loss_g),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_1f1b["W"]),
+                               np.asarray(p_gpipe["W"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(p_1f1b["b"]),
+                               np.asarray(p_gpipe["b"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_more_microbatches_than_ring():
+    # M > 2P-1 exercises ring-slot reuse (the 1F1B memory bound)
+    mesh = PP.make_pp_mesh(2)
+    params = PP.init_pipeline_params(jax.random.key(3), 2, 8)
+    mb = jax.random.normal(jax.random.key(4), (9, 2, 8))   # M=9 > 2*2-1=3
+    tgt = jax.random.normal(jax.random.key(5), (9, 2, 8))
+    p_g, loss_g = PP.pipeline_train_step(params, mb, tgt, mesh, lr=0.1)
+    p_f, loss_f = PP.pipeline_train_step_1f1b(params, mb, tgt, mesh, lr=0.1)
+    np.testing.assert_allclose(float(loss_f), float(loss_g),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p_f["W"]), np.asarray(p_g["W"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_1f1b_training_decreases_loss():
+    mesh = PP.make_pp_mesh(4)
+    params = PP.init_pipeline_params(jax.random.key(7), 4, 16, n_layers=2)
+    mb = jax.random.normal(jax.random.key(8), (4, 4, 16))
+    tgt = jnp.tanh(mb)
+    losses = []
+    for _ in range(30):
+        params, loss = PP.pipeline_train_step_1f1b(params, mb, tgt, mesh,
+                                                   lr=0.5)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert all(b <= a + 1e-6 for a, b in zip(losses, losses[1:])), losses
+
+
+# ---------------------------------------------------------------------------
+# round-4: top-k MoE with capacity factor + aux loss (VERDICT round-3 item 9)
+# ---------------------------------------------------------------------------
+
+
+def test_moe_top2_matches_oracle():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(0), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(1), (32, 16))
+    got = np.asarray(M.moe_forward(params, x, mesh, capacity=8, k=2))
+    want = M.reference_moe(params, np.asarray(x), 8, 4, k=2)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_top2_capacity_overflow():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(2), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(3), (32, 16))
+    got = np.asarray(M.moe_forward(params, x, mesh, capacity=1, k=2))
+    want = M.reference_moe(params, np.asarray(x), 1, 4, k=2)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_aux_loss_matches_dense():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(4), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(5), (32, 16))
+    _, aux = M.moe_forward(params, x, mesh, capacity=8, k=2,
+                           return_aux=True)
+    # dense Switch eq. 4, averaged over ranks like the kernel's psum
+    E, n_local = 4, 8
+    auxes = []
+    for r in range(E):
+        xs = np.asarray(x)[r * n_local:(r + 1) * n_local]
+        logits = xs @ np.asarray(params["Wg"])
+        pz = np.exp(logits - logits.max(-1, keepdims=True))
+        pz = pz / pz.sum(-1, keepdims=True)
+        f = np.bincount(pz.argmax(-1), minlength=E) / n_local
+        auxes.append(E * float((f * pz.mean(0)).sum()))
+    np.testing.assert_allclose(float(aux), np.mean(auxes),
+                               rtol=1e-4, atol=1e-5)
+    # uniform router -> aux ~ 1 (the balanced minimum)
+    params_u = dict(params, Wg=jnp.zeros_like(params["Wg"]))
+    _, aux_u = M.moe_forward(params_u, x, mesh, return_aux=True)
+    np.testing.assert_allclose(float(aux_u), 1.0, rtol=1e-5)
+
+
+def test_moe_capacity_factor_default():
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(6), 4, 16, 32)
+    x = jax.random.normal(jax.random.key(7), (32, 16))
+    # n_local=8, E=4: cf=2.0,k=1 -> C=4; generous cf -> no drops, out
+    # matches the no-drop oracle
+    got = np.asarray(M.moe_forward(params, x, mesh, k=1,
+                                   capacity_factor=8.0))
+    want = M.reference_moe(params, np.asarray(x), 8, 4, k=1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    with pytest.raises(ValueError, match="k must be"):
+        M.moe_forward(params, x, mesh, k=5)
