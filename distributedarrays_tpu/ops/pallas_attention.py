@@ -71,7 +71,8 @@ def _fit_block(b: int, extent: int) -> int:
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
-            scale: float, causal: bool, bq: int, bk: int, k_steps: int):
+            scale: float, causal: bool, bq: int, bk: int, k_steps: int,
+            hfold: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -90,66 +91,75 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     def _accumulate():
         # matmuls run at the INPUT dtype with f32 accumulation
         # (preferred_element_type): bf16 inputs take the fast MXU passes;
-        # an astype(f32) here would silently force 4x-slower f32 passes
-        q = q_ref[0]                                      # (bq, d)
-        k = k_ref[0]                                      # (bk, d)
-        v = v_ref[0]                                      # (bk, d)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        # an astype(f32) here would silently force 4x-slower f32 passes.
+        # ``hfold`` heads ride each grid step as a batched dot — at small
+        # head_dim (64) this fills the 128-wide lanes the per-head layout
+        # leaves half-idle (VERDICT round-3 item 3's tuning lever).
+        q = q_ref[:]                                      # (hfold, bq, d)
+        k = k_ref[:]                                      # (hfold, bk, d)
+        v = v_ref[:]                                      # (hfold, bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # (hfold, bq, bk)
         if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            qpos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (hfold, bq, bk), 1)
+            kpos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (hfold, bq, bk), 2)
             s = jnp.where(kpos <= qpos, s, -jnp.inf)
 
-        m_prev = m_ref[:]                                 # (bq, 1)
-        blk_max = jnp.max(s, axis=1, keepdims=True)
+        m_prev = m_ref[:]                                 # (hfold, bq, 1)
+        blk_max = jnp.max(s, axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, blk_max)
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - m_safe)
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
     @pl.when(ki == k_steps - 1)
     def _flush():
         l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        o_ref[:] = (acc_ref[:] / l).astype(o_ref.dtype)
         # per-row logsumexp, consumed by the backward kernels
         m_fin = jnp.where(jnp.isfinite(m_ref[:]), m_ref[:], 0.0)
-        lse_ref[0] = jnp.broadcast_to(m_fin + jnp.log(l), (bq, _LANE))
+        lse_ref[:] = jnp.broadcast_to(m_fin + jnp.log(l),
+                                      (hfold, bq, _LANE))
 
 
 @functools.lru_cache(maxsize=64)
-def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret):
+def _build(h, s, d, bq, bk, dtype_str, scale, causal, interpret,
+           hfold: int = 1):
     if pltpu is None:
         raise RuntimeError("pallas TPU namespace unavailable")
     k_steps = s // bk
     kern = functools.partial(_kernel, scale=scale, causal=causal,
-                             bq=bq, bk=bk, k_steps=k_steps)
+                             bq=bq, bk=bk, k_steps=k_steps, hfold=hfold)
     call = pl.pallas_call(
         kern,
-        grid=(h, s // bq, k_steps),
+        grid=(h // hfold, s // bq, k_steps),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
+            pl.BlockSpec((hfold, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
+            pl.BlockSpec((hfold, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, _LANE),
+                         lambda hh, qi, ki: (hh, qi, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((h, s, d), jnp.dtype(dtype_str)),
             jax.ShapeDtypeStruct((h, s, _LANE), jnp.float32),
         ),
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((hfold, bq, 1), jnp.float32),
+            pltpu.VMEM((hfold, bq, 1), jnp.float32),
+            pltpu.VMEM((hfold, bq, d), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -524,27 +534,27 @@ def _dense_attention_shd(q, k, v, causal: bool, scale: float):
     return o.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, causal, scale, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q, k, v, causal, scale, bq, bk, interpret, hfold=1):
     S, H, D = q.shape
     qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
     out, _ = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
-                    interpret)(qh, kh, vh)
+                    interpret, hfold)(qh, kh, vh)
     return jnp.transpose(out, (1, 0, 2))
 
 
-def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret, hfold=1):
     S, H, D = q.shape
     qh, kh, vh = (jnp.transpose(x, (1, 0, 2)) for x in (q, k, v))
     out, lse = _build(H, S, D, bq, bk, str(q.dtype), scale, causal,
-                      interpret)(qh, kh, vh)
+                      interpret, hfold)(qh, kh, vh)
     o = jnp.transpose(out, (1, 0, 2))
     # keep only one lane of the lane-broadcast lse in the residuals —
     # (H, S) instead of (H, S, 128); rebroadcast in the backward like dd
     return o, (q, k, v, o, lse[:, :, 0])
 
 
-def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+def _flash_bwd(causal, scale, bq, bk, interpret, hfold, res, g):
     # FlashAttention-2-style backward: recompute P blockwise from the saved
     # per-row logsumexp, sweep K blocks for dQ and Q blocks for dK/dV —
     # O(S·d) memory, no S×S materialization
@@ -572,38 +582,54 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     block_q: int | None = None, block_k: int | None = None,
+                    head_fold: int | None = None,
                     interpret: bool | None = None):
     """Exact attention over (seq, heads, head_dim) arrays without
     materializing the S×S score matrix.
 
-    Block sizes default to the autotune registry's tuned value for this
+    Block sizes (and the forward's ``head_fold`` — how many heads ride
+    each grid step as a batched dot, the lane-occupancy lever for small
+    head_dim) default to the autotune registry's tuned value for this
     (S, H, D, dtype, causal) — populated by ``utils.autotune`` sweeps
-    (bench.py runs one on hardware) — falling back to 512².  Either way
-    they are fitted to the sequence length (clipped, then halved until
-    they divide S).  Use as the per-rank compute inside ring attention,
-    or standalone single-chip.
+    (bench.py runs one on hardware) — falling back to 512²/1.  A 2- or
+    3-tuple cache entry is accepted ((bq, bk) or (bq, bk, hfold)).
+    Either way blocks are fitted to the sequence length (clipped, then
+    halved until they divide S); ``head_fold`` is clipped to a divisor
+    of H.  Use as the per-rank compute inside ring attention, or
+    standalone single-chip.
     """
     q, k, v = (jnp.asarray(x) for x in (q, k, v))
     if q.shape != k.shape or q.shape != v.shape or q.ndim != 3:
         raise ValueError(f"q/k/v must share (S, H, D), got {q.shape}, "
                          f"{k.shape}, {v.shape}")
     S, H, D = q.shape
-    if block_q is None or block_k is None:
+    if block_q is None or block_k is None or head_fold is None:
         from ..utils import autotune
         tuned = autotune.get(
             "flash_attention",
             autotune.key_for(S, H, D, q.dtype, bool(causal)))
         tq = tk = 512
+        tf = 1
         try:   # a malformed cache entry degrades to the default, never
-            a, b = tuned                            # breaks dispatch
-            if int(a) > 0 and int(b) > 0:
-                tq, tk = int(a), int(b)
+            vals = [int(x) for x in tuned]          # breaks dispatch
+            if len(vals) in (2, 3) and all(x > 0 for x in vals):
+                tq, tk = vals[0], vals[1]
+                tf = vals[2] if len(vals) == 3 else 1
         except Exception:
             pass
+        # the tuned head_fold was measured WITH the tuned blocks — graft
+        # it only onto callers that take both blocks from the registry
+        # too; a caller pinning its own blocks gets hfold=1 unless it
+        # also pins head_fold
+        use_tuned_fold = block_q is None and block_k is None
         block_q = tq if block_q is None else block_q
         block_k = tk if block_k is None else block_k
+        if head_fold is None:
+            head_fold = tf if use_tuned_fold else 1
     bq, bk = _fit_block(block_q, S), _fit_block(block_k, S)
+    hfold = _fit_block(max(int(head_fold), 1), H)
     if interpret is None:
         interpret = not _on_tpu()
     sc = float(1.0 / np.sqrt(D) if scale is None else scale)
-    return _flash_core(q, k, v, bool(causal), sc, bq, bk, bool(interpret))
+    return _flash_core(q, k, v, bool(causal), sc, bq, bk, bool(interpret),
+                       hfold)

@@ -53,3 +53,54 @@ def test_flash_block_fitting(qkv):
     got = np.asarray(flash_attention(q, k, v, causal=True, block_q=48))
     want = reference_attention(q, k, v, causal=True)
     assert np.abs(got - want).max() < 1e-5
+
+
+def test_flash_head_fold(qkv):
+    # hfold > 1: heads ride the grid step as a batched dot (the lane-
+    # occupancy lever for small head_dim); numerics identical
+    q, k, v = qkv
+    want = reference_attention(q, k, v)
+    for hf in (2, 3):   # 3 is clipped to a divisor of H=2 -> 2
+        got = np.asarray(flash_attention(q, k, v, block_q=32, block_k=32,
+                                         head_fold=hf))
+        assert np.abs(got - want).max() < 1e-5, hf
+    got_c = np.asarray(flash_attention(q, k, v, causal=True, block_q=32,
+                                       block_k=32, head_fold=2))
+    want_c = reference_attention(q, k, v, causal=True)
+    assert np.abs(got_c - want_c).max() < 1e-5
+
+
+def test_flash_head_fold_grads(qkv):
+    import jax
+    import jax.numpy as jnp
+    q, k, v = qkv
+
+    def loss(fold):
+        def f(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, causal=True,
+                                           block_q=32, block_k=32,
+                                           head_fold=fold) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g1 = loss(1)
+    g2 = loss(2)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_autotune_three_tuple_entry(qkv):
+    # a (bq, bk, hfold) registry entry drives dispatch; malformed entries
+    # degrade to the defaults
+    from distributedarrays_tpu.utils import autotune
+    q, k, v = qkv
+    want = reference_attention(q, k, v)
+    key = autotune.key_for(128, 2, 16, q.dtype, False)
+    autotune.clear()
+    autotune.record("flash_attention", key, (32, 32, 2))
+    got = np.asarray(flash_attention(q, k, v))
+    assert np.abs(got - want).max() < 1e-5
+    autotune.record("flash_attention", key, ("bogus",))
+    got = np.asarray(flash_attention(q, k, v))   # degrades, still correct
+    assert np.abs(got - want).max() < 1e-5
+    autotune.clear()
