@@ -12,10 +12,12 @@ the global edges, so results match the dense oracle exactly.
 ``dconv2d`` accepts:
 - a ``(H, W)`` DArray with a ``(kh, kw)`` kernel (single channel), or
 - an ``(N, H, W, C)`` DArray with a ``(kh, kw, Cin, Cout)`` kernel
-  (NHWC batched), sharded along the height dim in both cases.
+  (NHWC batched).
 
-Eligible layouts (even, sharded along height only, halo fitting the
-local block) run as ONE shard_map program; anything else warns once and
+Eligible layouts — even, sharded along any of N/height/width (a 2-D
+image grid runs the two-phase halo exchange with per-dim halo widths;
+corners ride the row-extended block), each halo fitting the local
+block — run as ONE shard_map program; anything else warns once and
 takes a host gather + dense conv.
 """
 
@@ -56,18 +58,32 @@ def _dense_conv(x, k):
 
 
 @functools.lru_cache(maxsize=64)
-def _conv_shm_jit(mesh, spec, name: str, hdim: int, hh: int):
+def _conv_shm_jit(mesh, spec, hname, wname, hdim: int, wdim: int,
+                  hh: int, hw: int):
+    """One shard_map conv program; ``hname``/``wname`` are the mesh axes
+    of the sharded height/width dims (None = resident).  Width sharding
+    runs the standard two-phase exchange — the column halo is taken from
+    the already row-extended block, so corners arrive correctly (same
+    scheme as ``halo_exchange_2d``), with per-dim halo widths for
+    non-square kernels."""
     from jax.sharding import PartitionSpec
 
     def kernel(x, k):
-        if hh:
-            lo, hi = halo_exchange(x, name, halo=hh, dim=hdim, wrap=False)
-            xp = jnp.concatenate([lo, x, hi], axis=hdim)
-        else:
-            xp = x
+        xp = x
+        if hname is not None and hh:
+            lo, hi = halo_exchange(xp, hname, halo=hh, dim=hdim, wrap=False)
+            xp = jnp.concatenate([lo, xp, hi], axis=hdim)
+        if wname is not None and hw:
+            lo, hi = halo_exchange(xp, wname, halo=hw, dim=wdim, wrap=False)
+            xp = jnp.concatenate([lo, xp, hi], axis=wdim)
         full = _dense_conv(xp, k)          # SAME over the halo'd block
-        return lax.slice_in_dim(full, hh, full.shape[hdim] - hh,
-                                axis=hdim)
+        if hname is not None and hh:
+            full = lax.slice_in_dim(full, hh, full.shape[hdim] - hh,
+                                    axis=hdim)
+        if wname is not None and hw:
+            full = lax.slice_in_dim(full, hw, full.shape[wdim] - hw,
+                                    axis=wdim)
+        return full
 
     return jax.jit(jax.shard_map(
         kernel, mesh=mesh, in_specs=(spec, PartitionSpec()),
@@ -98,26 +114,33 @@ def dconv2d(d: DArray, kernel) -> DArray:
         raise ValueError(f"dconv2d expects a 2-D or 4-D (NHWC) DArray, "
                          f"got ndim {d.ndim}")
     hh = int(k.shape[0]) // 2
+    hw = int(k.shape[1]) // 2
+    wdim = hdim + 1
 
     from .mapreduce import _even_shared_layout
     grid = list(d.pids.shape)
     sharded_dims = [i for i, g in enumerate(grid) if g > 1]
-    p = grid[hdim]
+    p, pw = grid[hdim], grid[wdim]
     # communication-free dims may shard freely: N (pure data parallel);
-    # the height dim needs the halo; W/C sharding would need more
-    free_dims = {0, hdim} if d.ndim == 4 else {hdim}
+    # height AND width sharding run the two-phase halo exchange (round-4
+    # — previously a 2-D image grid host-gathered); C sharding would
+    # need input-channel reductions
+    free_dims = {0, hdim, wdim} if d.ndim == 4 else {hdim, wdim}
     eligible = (_even_shared_layout((d,))
                 and set(sharded_dims) <= free_dims
-                and (p == 1 or d.dims[hdim] // p >= hh))
+                and (p == 1 or d.dims[hdim] // p >= hh)
+                and (pw == 1 or d.dims[wdim] // pw >= hw))
     if eligible:
-        name = d.sharding.spec[hdim]
-        if name is None or p == 1:
-            # height resident: zero-communication conv (GSPMD keeps any
+        hname = d.sharding.spec[hdim] if p > 1 else None
+        wname = (d.sharding.spec[wdim]
+                 if wdim < len(d.sharding.spec) and pw > 1 else None)
+        if hname is None and wname is None:
+            # image resident: zero-communication conv (GSPMD keeps any
             # batch sharding — each rank convolves its own N slice)
             res = jax.jit(_dense_conv)(d.garray, k)
         else:
-            res = _conv_shm_jit(d.sharding.mesh, d.sharding.spec, name,
-                                hdim, hh)(d.garray, k)
+            res = _conv_shm_jit(d.sharding.mesh, d.sharding.spec, hname,
+                                wname, hdim, wdim, hh, hw)(d.garray, k)
         # NHWC with Cout != C changes the trailing dim; _wrap_global
         # re-derives the layout from the result shape over the same grid
         return _wrap_global(res, procs=[int(q) for q in d.pids.flat],
@@ -126,8 +149,8 @@ def dconv2d(d: DArray, kernel) -> DArray:
     warn_once(f"dconv2d-host-{tuple(grid)}-{d.ndim}",
               f"dconv2d: layout (grid {tuple(grid)}) is not eligible for "
               "the halo-exchange path (needs an even layout sharded only "
-              "along height, with the halo fitting the local block); "
-              "gathering to host for a dense conv")
+              "along N/height/width, with each halo fitting the local "
+              "block); gathering to host for a dense conv")
     res = np.asarray(_dense_conv(jnp.asarray(np.asarray(d)), k))
     if res.shape == d.dims:
         return darray_from_cuts(res, [int(q) for q in d.pids.flat], d.cuts)

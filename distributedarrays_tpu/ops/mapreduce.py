@@ -298,8 +298,9 @@ def _scan_impl(d: DArray, axis: int, kind: str) -> DArray:
     program — local ``jnp.cum{sum,prod}``, ``all_gather`` of the (tiny)
     per-rank totals over the dim's mesh axis, each rank combining the
     totals of lower ranks into its offset.  Communication is O(p · slice)
-    regardless of array size.  Uneven layouts: host scan reassembled with
-    the exact chunk structure kept (``from_chunks``)."""
+    regardless of array size.  Uneven layouts run the SAME program over
+    the blocked-padded physical buffer with per-rank valid extents from
+    the cuts — no host gather on any layout."""
     if not isinstance(d, DArray):
         raise TypeError(f"expected DArray, got {type(d).__name__}")
     ax = axis + d.ndim if axis < 0 else axis
@@ -315,24 +316,21 @@ def _scan_impl(d: DArray, axis: int, kind: str) -> DArray:
         return _wrap_global(res, procs=[int(p) for p in d.pids.flat],
                             dist=list(d.pids.shape))
 
-    # uneven: host scan, exact cut structure kept (one device_put) —
-    # loud like every other documented degradation (one policy: a host
-    # gather is never silent, VERDICT round-3 item 6)
-    from ..utils.debug import warn_once
-    warn_once(f"dscan-host-{kind}-{d.pids.shape}-{tuple(d.dims)}",
-              f"d_cum{kind}: uneven layout (grid {tuple(d.pids.shape)}, "
-              f"dims {tuple(d.dims)}) is not eligible for the compiled "
-              "shard_map scan (needs an even layout); gathering to host "
-              "for a numpy scan")
-    full = np.asarray(d)
-    scanned = _SCAN_NP[kind](full, axis=ax)
-    from ..darray import darray_from_cuts
-    return darray_from_cuts(scanned, [int(p) for p in d.pids.flat], d.cuts)
+    # uneven: the SAME parallel-prefix program over the blocked-padded
+    # physical buffer (PSRS-style, round-4) — local scan per block, the
+    # per-block total read at each rank's VALID extent (from the cuts),
+    # gathered along the scan dim's mesh axis.  No host gather; the
+    # result keeps the exact padded storage + cut structure.
+    vcounts = jnp.asarray(np.diff(np.asarray(d.cuts[ax])), jnp.int32)
+    pspec = tuple(d._psharding.spec)
+    fn = _scan_uneven_shm_jit(
+        d._psharding, kind, ax,
+        pspec[ax] if ax < len(pspec) else None)
+    res = fn(d.garray_padded, vcounts)
+    return DArray(res, d.pids, d.indices, d.cuts)
 
 
-# kind -> (local scan, host scan, cross-rank combine, elementwise merge)
-_SCAN_NP = {"sum": np.cumsum, "prod": np.cumprod,
-            "max": np.maximum.accumulate, "min": np.minimum.accumulate}
+# kind -> (local scan, cross-rank combine, elementwise merge)
 def _cum_extreme(op):
     def f(a, axis):
         if jnp.issubdtype(a.dtype, jnp.bool_):
@@ -369,6 +367,39 @@ def _scan_neutral(kind: str, dtype):
 def _scan_local_jit(kind: str, ax: int):
     op = _SCAN_LOCAL[kind]
     return jax.jit(lambda a: op(a, axis=ax))
+
+
+@functools.lru_cache(maxsize=64)
+def _scan_uneven_shm_jit(psharding, kind: str, ax: int, name):
+    """Compiled scan over the blocked-padded buffer of an UNEVEN layout:
+    identical structure to ``_scan_shm_jit`` except each rank's chunk
+    total is read at its valid extent (``vcounts``) instead of the block
+    edge, and 0-sized chunks contribute the scan's neutral element.
+    Positions past a block's valid extent hold garbage — exactly the pad
+    zone the logical view never exposes."""
+    local_scan = _SCAN_LOCAL[kind]
+    from jax.sharding import PartitionSpec as _P
+
+    def kernel(x, vcounts):
+        loc = local_scan(x, axis=ax)
+        if name is None:        # scan dim whole per rank: local only
+            return loc
+        r = jax.lax.axis_index(name)
+        p = jax.lax.axis_size(name)
+        v = vcounts[r]
+        neutral = _scan_neutral(kind, loc.dtype)
+        tot = jax.lax.dynamic_index_in_dim(
+            loc, jnp.maximum(v - 1, 0), ax, keepdims=True)
+        tot = jnp.where(v > 0, tot, neutral)
+        g = jax.lax.all_gather(tot, name)        # (p, ..., 1, ...)
+        mask = (jnp.arange(p) < r).reshape((p,) + (1,) * loc.ndim)
+        filled = jnp.where(mask, g, neutral)
+        prefix = _SCAN_COMBINE[kind](filled, axis=0)
+        return _SCAN_MERGE[kind](loc, prefix)
+
+    return jax.jit(jax.shard_map(
+        kernel, mesh=psharding.mesh,
+        in_specs=(psharding.spec, _P()), out_specs=psharding.spec))
 
 
 @functools.lru_cache(maxsize=128)

@@ -125,12 +125,18 @@ ds = dat.distribute(S1)                     # layout spans both processes
 cs = dat.dcumsum(ds, axis=0)                # shard_map scan over the DCN mesh
 np.testing.assert_allclose(multihost.gather_global(cs),
                            np.cumsum(S1, axis=0), rtol=1e-5, atol=1e-5)
+# round-4: UNEVEN scan (padded compiled path) across processes
+su = np.arange(50.0, dtype=np.float32) / 9
+dsu = dat.distribute(su)                    # cuts [7,7,6,6,6,6,6,6]
+csu = dat.dcumsum(dsu)
+np.testing.assert_allclose(multihost.gather_global(csu),
+                           np.cumsum(su), rtol=1e-5, atol=1e-5)
 F1 = np.sin(np.arange(32.0 * 16, dtype=np.float32)).reshape(32, 16)
 dfm = dat.distribute(F1, procs=range(8), dist=(8, 1))
 ff = dat.dfft(dfm, axis=0)                  # all_to_all across processes
 np.testing.assert_allclose(multihost.gather_global(ff),
                            np.fft.fft(F1, axis=0), rtol=1e-3, atol=1e-3)
-for a in (ds, cs, dfm, ff):
+for a in (ds, cs, dsu, csu, dfm, ff):
     a.close()
 
 # --- round-4 legs (VERDICT round-3 item 8) --------------------------------

@@ -36,10 +36,27 @@ def test_dconv2d_nhwc_cout_change(rng):
     dat.d_closeall()
 
 
-def test_dconv2d_ineligible_warns_and_matches(rng):
+def test_dconv2d_2d_grid_compiled(rng):
+    # round-4: a height x width image grid runs the two-phase halo
+    # exchange (corners via the row-extended block) — compiled, silent
     A = rng.standard_normal((64, 32)).astype(np.float32)
+    for kshape in [(3, 3), (5, 3), (3, 5), (1, 3)]:
+        K = rng.standard_normal(kshape).astype(np.float32)
+        d = dat.distribute(A, procs=range(8), dist=(4, 2))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            got = np.asarray(dat.dconv2d(d, K))
+        want = np.asarray(_dense_conv(jnp.asarray(A), jnp.asarray(K)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=str(kshape))
+    dat.d_closeall()
+
+
+def test_dconv2d_ineligible_warns_and_matches(rng):
+    # uneven layout: still the documented host degradation, loud
+    A = rng.standard_normal((50, 32)).astype(np.float32)
     K = rng.standard_normal((3, 3)).astype(np.float32)
-    d = dat.distribute(A, procs=range(8), dist=(4, 2))  # 2-D grid
+    d = dat.distribute(A, procs=range(4), dist=(4, 1))  # uneven cuts
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         got = np.asarray(dat.dconv2d(d, K))

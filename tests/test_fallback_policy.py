@@ -28,13 +28,17 @@ def fresh_warn_registry():
     dat.d_closeall()
 
 
-def test_uneven_scan_warns(rng):
+def test_uneven_scan_compiled_and_silent(rng):
+    # round-4: uneven scans run the padded compiled path — there is no
+    # scan host fallback left to warn about
     d = dat.distribute(rng.standard_normal(50).astype(np.float32),
                        procs=range(4))
-    with pytest.warns(RuntimeWarning, match="gathering to host"):
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
         got = dat.dcumsum(d)
     np.testing.assert_allclose(np.asarray(got),
                                np.cumsum(np.asarray(d)), rtol=1e-4)
+    assert got.cuts == d.cuts
 
 
 def test_even_scan_does_not_warn(rng):
@@ -88,8 +92,8 @@ def test_fft_conv_host_paths_warn(rng):
                        procs=range(4))
     with pytest.warns(RuntimeWarning, match="gathering"):
         dat.dfft(V)
-    A = dat.distribute(rng.standard_normal((16, 16)).astype(np.float32),
-                       procs=range(4), dist=(2, 2))
+    A = dat.distribute(rng.standard_normal((50, 16)).astype(np.float32),
+                       procs=range(4), dist=(4, 1))   # uneven cuts
     k = np.ones((3, 3), np.float32)
     with pytest.warns(RuntimeWarning):
         dat.dconv2d(A, k)

@@ -407,3 +407,68 @@ def test_dcummax_bool_and_inf_edge_cases(rng):
     np.testing.assert_array_equal(np.asarray(dat.dcummax(da)),
                                   np.maximum.accumulate(A))
     dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# round-4: uneven scans run the padded compiled path (no host gather)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind,np_scan", [
+    ("dcumsum", np.cumsum), ("dcumprod", np.cumprod),
+    ("dcummax", np.maximum.accumulate), ("dcummin", np.minimum.accumulate)])
+def test_uneven_scan_all_kinds(kind, np_scan, rng):
+    import warnings
+    x = (rng.standard_normal(50) * 0.5 + 1.0).astype(np.float32)
+    d = dat.distribute(x, procs=range(4))     # cuts [13,13,12,12]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        got = getattr(dat, kind)(d)
+    np.testing.assert_allclose(np.asarray(got), np_scan(x),
+                               rtol=1e-4, atol=1e-5)
+    assert got.cuts == d.cuts
+
+
+def test_uneven_2d_scan_both_axes(rng):
+    A = rng.standard_normal((50, 6)).astype(np.float32)
+    d = dat.distribute(A, procs=range(8), dist=(4, 2))  # dim0 uneven
+    got0 = dat.dcumsum(d, axis=0)             # scan along the uneven dim
+    np.testing.assert_allclose(np.asarray(got0), np.cumsum(A, axis=0),
+                               rtol=1e-4, atol=1e-4)
+    got1 = dat.dcumsum(d, axis=1)             # uneven elsewhere, even here
+    np.testing.assert_allclose(np.asarray(got1), np.cumsum(A, axis=1),
+                               rtol=1e-4, atol=1e-4)
+    assert got0.cuts == d.cuts and got1.cuts == d.cuts
+
+
+def test_uneven_scan_zero_sized_chunk(rng):
+    # 3 elements over 4 ranks: one chunk is empty -> neutral contribution
+    x = rng.standard_normal(3).astype(np.float32)
+    d = dat.distribute(x, procs=range(4))
+    got = dat.dcumsum(d)
+    np.testing.assert_allclose(np.asarray(got), np.cumsum(x), rtol=1e-5)
+
+
+def test_uneven_scan_bool_cummax(rng):
+    x = np.array([0, 0, 1, 0, 0, 0, 1, 0, 0, 0], dtype=bool)
+    d = dat.distribute(x, procs=range(4))
+    got = dat.dcummax(d)
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.maximum.accumulate(x))
+
+
+def test_scan_jit_wrappers_are_cached(rng):
+    # regression: repeated scans must reuse one jit wrapper per
+    # (layout, kind, axis) — a lost lru_cache means a recompile per call
+    from distributedarrays_tpu.ops import mapreduce as MR
+    d = dat.distribute(rng.standard_normal(64).astype(np.float32),
+                       procs=range(4))
+    h0 = MR._scan_shm_jit.cache_info().hits
+    dat.dcumsum(d); dat.dcumsum(d)
+    assert MR._scan_shm_jit.cache_info().hits > h0
+    du = dat.distribute(rng.standard_normal(50).astype(np.float32),
+                        procs=range(4))
+    h1 = MR._scan_uneven_shm_jit.cache_info().hits
+    dat.dcumsum(du); dat.dcumsum(du)
+    assert MR._scan_uneven_shm_jit.cache_info().hits > h1
+    dat.d_closeall()
