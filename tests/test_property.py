@@ -179,3 +179,55 @@ def test_dfft_matches_numpy_any_rowshard(n, data):
     np.testing.assert_allclose(got, np.fft.fft(A, axis=ax).astype(np.complex64),
                                rtol=1e-3, atol=1e-3)
     dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# round-4 paths: uneven compiled scans, four-step 1-D FFT, top-k MoE
+# ---------------------------------------------------------------------------
+
+
+@settings(max_examples=25, deadline=None)
+@given(n=st.integers(1, 300), p=st.integers(1, 8),
+       kind=st.sampled_from(["sum", "max", "min"]), data=st.data())
+def test_scan_any_layout_matches_numpy(n, p, kind, data):
+    # every (length, ranks) pair — even, uneven, n < p with empty chunks —
+    # must scan identically to numpy, compiled, with the layout kept
+    x = np.asarray(data.draw(st.lists(
+        st.floats(-8, 8, width=32), min_size=n, max_size=n)), np.float32)
+    d = dat.distribute(x, procs=range(p))
+    got = getattr(dat, f"dcum{kind}")(d)
+    oracle = {"sum": np.cumsum, "max": np.maximum.accumulate,
+              "min": np.minimum.accumulate}[kind]
+    np.testing.assert_allclose(np.asarray(got), oracle(x),
+                               rtol=1e-4, atol=1e-4)
+    assert got.cuts == d.cuts
+    dat.d_closeall()
+
+
+@settings(max_examples=15, deadline=None)
+@given(m=st.integers(1, 6), p=st.sampled_from([1, 2, 4, 8]))
+def test_dfft_1d_four_step_matches_numpy(m, p):
+    # lengths m * p^2: always the compiled four-step path; oracle numpy
+    n = m * p * p
+    rng = np.random.default_rng(n * 31 + p)
+    x = rng.standard_normal(n).astype(np.float32)
+    d = dat.distribute(x, procs=range(p))
+    got = np.asarray(dat.dfft(d))
+    np.testing.assert_allclose(got, np.fft.fft(x).astype(np.complex64),
+                               rtol=2e-3, atol=2e-3)
+    back = np.asarray(dat.difft(dat.dfft(d))).real
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+    dat.d_closeall()
+
+
+@settings(max_examples=10, deadline=None)
+@given(k=st.integers(1, 4), cap=st.integers(1, 8), seed=st.integers(0, 99))
+def test_moe_topk_matches_oracle_any_k_capacity(k, cap, seed):
+    import jax
+    from distributedarrays_tpu.models import moe as M
+    mesh = M.make_ep_mesh(4)
+    params = M.init_moe_params(jax.random.key(seed), 4, 8, 16)
+    x = jax.random.normal(jax.random.key(seed + 1), (16, 8))
+    got = np.asarray(M.moe_forward(params, x, mesh, capacity=cap, k=k))
+    want = M.reference_moe(params, np.asarray(x), cap, 4, k=k)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
