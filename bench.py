@@ -362,6 +362,9 @@ def main():
     def cfg_matmul_impl_tune():
         from distributedarrays_tpu.utils import autotune
         from distributedarrays_tpu.ops import linalg as _la
+        # DAT_BENCH_TUNE_N: harness-validation override — the 4096 shape
+        # in interpret-mode Pallas is unboundedly slow on host CPU
+        TN = int(os.environ.get("DAT_BENCH_TUNE_N", N))
 
         def chain_timer(op, a, b):
             # the trusted t(L)/L method, handed to the API's tuner so
@@ -386,17 +389,19 @@ def main():
         # never persist where a TPU process would load it (the registry
         # key carries the device kind as a second fence)
         persist = _PLATFORM != "cpu" and jax.default_backend() != "cpu"
-        out = {}
+        # the shape is part of the result's identity: an override run
+        # (harness validation) must never read as headline-4096 numbers
+        out = {"matmul_impl_tune_n": TN}
         for dt, tag in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
             winner, results = _la.tune_matmul_impl(
-                N, N, N, dtype=dt, timer=chain_timer, persist=persist)
+                TN, TN, TN, dtype=dt, timer=chain_timer, persist=persist)
             for impl, t in results.items():
                 if t != float("inf"):
                     out[f"matmul_impl_{tag}_{impl}_s_per_iter"] = t
             out[f"matmul_impl_{tag}_winner"] = winner
         if len(jax.devices()) >= 2:
             winner, results = _la.tune_matmul_impl_dist(
-                N, N, N, timer=chain_timer, persist=persist)
+                TN, TN, TN, timer=chain_timer, persist=persist)
             for impl, t in results.items():
                 if t != float("inf"):
                     out[f"matmul_impl_dist_{impl}_s_per_iter"] = t
