@@ -413,132 +413,6 @@ def main():
     _guarded(details, "matmul_impl_tune", cfg_matmul_impl_tune,
              timeout_s=600)
 
-    # ---- config 1: broadcast chain sin.(A) .+ B .* C on 8192^2 ----------
-    M = 8192
-    X = dat.drand((M, M)); Y = dat.drand((M, M)); Z = dat.drand((M, M))
-
-    def chain_chain(L):
-        @dat.djit
-        def f(a, b, c):
-            def body(acc, _):
-                return jnp.sin(acc) + b * c, None
-            acc, _ = lax.scan(body, a, None, length=L)
-            return jnp.sum(acc)
-        float(f(X, Y, Z))
-        return min(_t(lambda: float(f(X, Y, Z))) for _ in range(2))
-
-    def cfg_chain():
-        t_chain, L = _periter(chain_chain, L0=32)
-        return {"broadcast_chain_8192_s_per_iter": t_chain,
-                "broadcast_chain_8192_gbps": 4 * M * M * 4 / t_chain / 1e9}
-
-    _guarded(details, "broadcast_chain", cfg_chain)
-
-    # ---- config 2: mapreduce(abs2,+) and mean/std over 1e8 --------------
-    V = dat.drand((100_000_000,))
-
-    def mr_chain(L):
-        @dat.djit
-        def f(v):
-            def body(acc, _):
-                # acc feeds back so the reduction re-reads v every iteration
-                return acc * 1e-30 + jnp.sum(jnp.square(v + acc * 1e-30)), None
-            acc, _ = lax.scan(body, jnp.float32(0), None, length=L)
-            return acc
-        float(f(V))
-        return min(_t(lambda: float(f(V))) for _ in range(2))
-
-    def cfg_mr():
-        t_mr, L = _periter(mr_chain, L0=64)
-        out = {"mapreduce_1e8_s_per_iter": t_mr,
-               "mapreduce_1e8_gbps": 4 * 1e8 / t_mr / 1e9}
-        float(dat.dmean(V)); float(dat.dstd(V))
-        out["mean_std_1e8_eager_s"] = _t(
-            lambda: (float(dat.dmean(V)), float(dat.dstd(V))))
-        return out
-
-    _guarded(details, "mapreduce", cfg_mr)
-
-    # ---- config 4: stencil halo exchange on 8192^2 -----------------------
-    rows = (M // ndev) * ndev
-    S = dat.drand((rows, M), procs=range(ndev), dist=(ndev, 1))
-
-    def st(iters, use_pallas=None, temporal=None):
-        r = stencil.stencil5(S, iters=iters, use_pallas=use_pallas,
-                             temporal=temporal)
-        v = float(dat.dsum(r))                       # one compiled scan
-        r.close()
-        return v
-
-    def st_len_at(use_pallas, temporal=None):
-        def st_len(L):
-            st(L, use_pallas, temporal)              # compile
-            return min(_t(lambda: st(L, use_pallas, temporal))
-                       for _ in range(2))
-        return st_len
-
-    # single-step streaming kernel (the BASELINE config semantics: one
-    # halo exchange per step), the jnp formulation for comparison, and the
-    # temporal-blocked kernel (k=8 steps per launch, ghost-zone scheme)
-    def cfg_stencil():
-        t_st, L = _periter(st_len_at(None, temporal=1), L0=16)
-        return {"stencil_8192_step_s_per_iter": t_st,
-                "stencil_8192_gcells_per_s": rows * M / t_st / 1e9}
-
-    def cfg_stencil_jnp():
-        t_stj, L = _periter(st_len_at(False), L0=16)
-        return {"stencil_8192_jnp_gcells_per_s": rows * M / t_stj / 1e9}
-
-    def cfg_stencil_temporal():
-        t_stt, L = _periter(st_len_at(None), L0=32)  # auto temporal depth
-        return {"stencil_8192_temporal_s_per_iter": t_stt,
-                "stencil_8192_temporal_gcells_per_s": rows * M / t_stt / 1e9}
-
-    _guarded(details, "stencil", cfg_stencil)
-    _guarded(details, "stencil_jnp", cfg_stencil_jnp)
-    _guarded(details, "stencil_temporal", cfg_stencil_temporal)
-
-    # free the bandwidth-config buffers before the 16k arrays go up
-    for arr in (X, Y, Z, V, S):
-        arr.close()
-
-    # ---- config 3: 16384^2 GEMM on an explicit block layout --------------
-    # BASELINE.json configs[3]; reference semantics = the tile-grid
-    # _matmatmul! (/root/reference/src/linalg.jl:189-311), here one jitted
-    # matmul over block-sharded operands (XLA SUMMA over ICI).  A true 2x2
-    # grid needs >=4 devices; on fewer the grid degrades and the key label
-    # says which grid actually ran.  bf16-pass first (banked); the riskier
-    # f32-HIGHEST pass runs in the guarded tail below.
-    K16 = 16384
-    g3 = (2, 2) if ndev >= 4 else (1, 1)
-    tag = f"gemm_16k_{g3[0]}x{g3[1]}"
-    A3 = dat.drand((K16, K16), dtype=jnp.float32,
-                   procs=range(g3[0] * g3[1]), dist=g3)
-    B3 = dat.drand((K16, K16), dtype=jnp.float32,
-                   procs=range(g3[0] * g3[1]), dist=g3)
-    s16 = jnp.float32(1.0 / K16)
-
-    def gemm16_chain_at(precision):
-        def gemm16_chain(L):
-            @dat.djit
-            def f(a, b):
-                def body(c, _):
-                    return jnp.matmul(c, b, precision=precision) * s16, None
-                c, _ = lax.scan(body, a, None, length=L)
-                return jnp.sum(c)
-            float(f(A3, B3))
-            return min(_t(lambda: float(f(A3, B3))) for _ in range(2))
-        return gemm16_chain
-
-    def cfg_gemm16():
-        t16, L = _periter(gemm16_chain_at(jax.lax.Precision.DEFAULT), L0=2)
-        g = 2 * K16**3 / t16 / 1e9
-        out = {f"{tag}_bf16pass_s_per_iter": t16,
-               f"{tag}_bf16pass_gflops": g}
-        _bank_tflops(out, f"{tag}_bf16pass", g / 1e3, peak)
-        return out
-
-    _guarded(details, tag, cfg_gemm16, timeout_s=600)
 
     # ---- extra: Pallas flash attention at long context -------------------
     def cfg_flash():
@@ -706,6 +580,133 @@ def main():
         return out
 
     _guarded(details, "flash_attn_d128", cfg_flash_d128, timeout_s=600)
+
+    # ---- config 1: broadcast chain sin.(A) .+ B .* C on 8192^2 ----------
+    M = 8192
+    X = dat.drand((M, M)); Y = dat.drand((M, M)); Z = dat.drand((M, M))
+
+    def chain_chain(L):
+        @dat.djit
+        def f(a, b, c):
+            def body(acc, _):
+                return jnp.sin(acc) + b * c, None
+            acc, _ = lax.scan(body, a, None, length=L)
+            return jnp.sum(acc)
+        float(f(X, Y, Z))
+        return min(_t(lambda: float(f(X, Y, Z))) for _ in range(2))
+
+    def cfg_chain():
+        t_chain, L = _periter(chain_chain, L0=32)
+        return {"broadcast_chain_8192_s_per_iter": t_chain,
+                "broadcast_chain_8192_gbps": 4 * M * M * 4 / t_chain / 1e9}
+
+    _guarded(details, "broadcast_chain", cfg_chain)
+
+    # ---- config 2: mapreduce(abs2,+) and mean/std over 1e8 --------------
+    V = dat.drand((100_000_000,))
+
+    def mr_chain(L):
+        @dat.djit
+        def f(v):
+            def body(acc, _):
+                # acc feeds back so the reduction re-reads v every iteration
+                return acc * 1e-30 + jnp.sum(jnp.square(v + acc * 1e-30)), None
+            acc, _ = lax.scan(body, jnp.float32(0), None, length=L)
+            return acc
+        float(f(V))
+        return min(_t(lambda: float(f(V))) for _ in range(2))
+
+    def cfg_mr():
+        t_mr, L = _periter(mr_chain, L0=64)
+        out = {"mapreduce_1e8_s_per_iter": t_mr,
+               "mapreduce_1e8_gbps": 4 * 1e8 / t_mr / 1e9}
+        float(dat.dmean(V)); float(dat.dstd(V))
+        out["mean_std_1e8_eager_s"] = _t(
+            lambda: (float(dat.dmean(V)), float(dat.dstd(V))))
+        return out
+
+    _guarded(details, "mapreduce", cfg_mr)
+
+    # ---- config 4: stencil halo exchange on 8192^2 -----------------------
+    rows = (M // ndev) * ndev
+    S = dat.drand((rows, M), procs=range(ndev), dist=(ndev, 1))
+
+    def st(iters, use_pallas=None, temporal=None):
+        r = stencil.stencil5(S, iters=iters, use_pallas=use_pallas,
+                             temporal=temporal)
+        v = float(dat.dsum(r))                       # one compiled scan
+        r.close()
+        return v
+
+    def st_len_at(use_pallas, temporal=None):
+        def st_len(L):
+            st(L, use_pallas, temporal)              # compile
+            return min(_t(lambda: st(L, use_pallas, temporal))
+                       for _ in range(2))
+        return st_len
+
+    # single-step streaming kernel (the BASELINE config semantics: one
+    # halo exchange per step), the jnp formulation for comparison, and the
+    # temporal-blocked kernel (k=8 steps per launch, ghost-zone scheme)
+    def cfg_stencil():
+        t_st, L = _periter(st_len_at(None, temporal=1), L0=16)
+        return {"stencil_8192_step_s_per_iter": t_st,
+                "stencil_8192_gcells_per_s": rows * M / t_st / 1e9}
+
+    def cfg_stencil_jnp():
+        t_stj, L = _periter(st_len_at(False), L0=16)
+        return {"stencil_8192_jnp_gcells_per_s": rows * M / t_stj / 1e9}
+
+    def cfg_stencil_temporal():
+        t_stt, L = _periter(st_len_at(None), L0=32)  # auto temporal depth
+        return {"stencil_8192_temporal_s_per_iter": t_stt,
+                "stencil_8192_temporal_gcells_per_s": rows * M / t_stt / 1e9}
+
+    _guarded(details, "stencil", cfg_stencil)
+    _guarded(details, "stencil_jnp", cfg_stencil_jnp)
+    _guarded(details, "stencil_temporal", cfg_stencil_temporal)
+
+    # free the bandwidth-config buffers before the 16k arrays go up
+    for arr in (X, Y, Z, V, S):
+        arr.close()
+
+    # ---- config 3: 16384^2 GEMM on an explicit block layout --------------
+    # BASELINE.json configs[3]; reference semantics = the tile-grid
+    # _matmatmul! (/root/reference/src/linalg.jl:189-311), here one jitted
+    # matmul over block-sharded operands (XLA SUMMA over ICI).  A true 2x2
+    # grid needs >=4 devices; on fewer the grid degrades and the key label
+    # says which grid actually ran.  bf16-pass first (banked); the riskier
+    # f32-HIGHEST pass runs in the guarded tail below.
+    K16 = 16384
+    g3 = (2, 2) if ndev >= 4 else (1, 1)
+    tag = f"gemm_16k_{g3[0]}x{g3[1]}"
+    A3 = dat.drand((K16, K16), dtype=jnp.float32,
+                   procs=range(g3[0] * g3[1]), dist=g3)
+    B3 = dat.drand((K16, K16), dtype=jnp.float32,
+                   procs=range(g3[0] * g3[1]), dist=g3)
+    s16 = jnp.float32(1.0 / K16)
+
+    def gemm16_chain_at(precision):
+        def gemm16_chain(L):
+            @dat.djit
+            def f(a, b):
+                def body(c, _):
+                    return jnp.matmul(c, b, precision=precision) * s16, None
+                c, _ = lax.scan(body, a, None, length=L)
+                return jnp.sum(c)
+            float(f(A3, B3))
+            return min(_t(lambda: float(f(A3, B3))) for _ in range(2))
+        return gemm16_chain
+
+    def cfg_gemm16():
+        t16, L = _periter(gemm16_chain_at(jax.lax.Precision.DEFAULT), L0=2)
+        g = 2 * K16**3 / t16 / 1e9
+        out = {f"{tag}_bf16pass_s_per_iter": t16,
+               f"{tag}_bf16pass_gflops": g}
+        _bank_tflops(out, f"{tag}_bf16pass", g / 1e3, peak)
+        return out
+
+    _guarded(details, tag, cfg_gemm16, timeout_s=600)
 
     # ---- extra: fused (Pallas) vs einsum ring-attention hop --------------
     # One chip = a 1-rank ring, so this isolates the per-hop compute the
