@@ -282,3 +282,54 @@ def test_tune_matmul_impl_dist_banks_winner():
     with pytest.raises(ValueError, match="divisible"):
         la.tune_matmul_impl_dist(63, 16, 32, p=4, timer=timer)
     autotune.clear()
+
+
+def test_dmatmul_int8_single_device(rng):
+    A = rng.standard_normal((128, 64)).astype(np.float32)
+    B = rng.standard_normal((64, 96)).astype(np.float32)
+    da = dat.distribute(A, procs=[0], dist=(1, 1))
+    C = dat.dmatmul_int8(da, B)
+    ref = A @ B
+    assert np.abs(np.asarray(C) - ref).max() / np.abs(ref).max() < 3e-2
+
+
+def test_dmatmul_int8_row_sharded(rng):
+    A = rng.standard_normal((128, 64)).astype(np.float32)
+    B = rng.standard_normal((64, 96)).astype(np.float32)
+    da = dat.distribute(A, procs=range(4), dist=(4, 1))
+    C = dat.dmatmul_int8(da, dat.distribute(B))
+    assert list(C.pids.shape) == [4, 1]
+    ref = A @ B
+    assert np.abs(np.asarray(C) - ref).max() / np.abs(ref).max() < 3e-2
+    dat.d_closeall()
+
+
+def test_dmatmul_int8_validation(rng):
+    A = rng.standard_normal((50, 64)).astype(np.float32)  # uneven rows
+    da = dat.distribute(A, procs=range(4), dist=(4, 1))
+    with pytest.raises(ValueError, match="even"):
+        dat.dmatmul_int8(da, np.zeros((64, 8), np.float32))
+    db = dat.distribute(rng.standard_normal((64, 32)).astype(np.float32),
+                        procs=range(8), dist=(2, 4))
+    da2 = dat.distribute(rng.standard_normal((16, 64)).astype(np.float32),
+                         procs=range(8), dist=(2, 4))
+    with pytest.raises(ValueError, match="grid"):
+        dat.dmatmul_int8(da2, db)
+    with pytest.raises(ValueError, match="mismatch"):
+        dat.dmatmul_int8(dat.distribute(A, procs=[0], dist=(1, 1)),
+                         np.zeros((8, 8), np.float32))
+    dat.d_closeall()
+
+
+def test_dmatmul_int8_host_array_lhs(rng):
+    # plain ndarray A lands on a supported layout automatically
+    A = rng.standard_normal((128, 64)).astype(np.float32)   # 128 % 8 == 0
+    B = rng.standard_normal((64, 96)).astype(np.float32)
+    C = dat.dmatmul_int8(A, B)
+    ref = A @ B
+    assert np.abs(np.asarray(C) - ref).max() / np.abs(ref).max() < 3e-2
+    A2 = rng.standard_normal((51, 64)).astype(np.float32)   # indivisible
+    C2 = dat.dmatmul_int8(A2, B)
+    ref2 = A2 @ B
+    assert np.abs(np.asarray(C2) - ref2).max() / np.abs(ref2).max() < 3e-2
+    dat.d_closeall()

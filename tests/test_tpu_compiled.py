@@ -295,3 +295,20 @@ def test_matmul_dispatch_pallas_promoted_compiled():
     finally:
         autotune.clear()
         dat.d_closeall()
+
+
+def test_dmatmul_int8_compiled():
+    # the DArray-level dynamic int8 GEMM (per-shard Pallas under
+    # shard_map on a 1-device mesh) must lower on real hardware
+    import distributedarrays_tpu as dat
+    try:
+        A = np.asarray(jax.random.normal(jax.random.key(40), (1024, 512),
+                                         jnp.float32))
+        B = np.asarray(jax.random.normal(jax.random.key(41), (512, 768),
+                                         jnp.float32))
+        got = np.asarray(dat.dmatmul_int8(dat.distribute(A, procs=[0],
+                                                         dist=(1, 1)), B))
+        want = A @ B
+        assert np.abs(got - want).max() / np.abs(want).max() < 3e-2
+    finally:
+        dat.d_closeall()
