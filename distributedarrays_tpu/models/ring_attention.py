@@ -266,7 +266,8 @@ _ring_flash_core.defvjp(_ring_flash_core_fwd, _ring_flash_core_bwd)
 
 def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
                                 scale: float | None = None,
-                                block_q: int = 512, block_k: int = 512,
+                                block_q: int | None = None,
+                                block_k: int | None = None,
                                 interpret: bool | None = None):
     """Fused ring attention: each hop's blockwise accumulate is ONE Pallas
     flash program (VMEM-resident online softmax, no (h, b, b) score
@@ -282,9 +283,34 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
     accumulators with their blocks — sequence-parallel training runs at
     Pallas speed (VERDICT round-3 item 3).
     """
+    block_q, block_k = _tuned_hop_blocks(q, bool(causal), block_q, block_k)
     sc = None if scale is None else float(scale)
     return _ring_flash_core(q, k, v, axis, bool(causal), sc,
                             int(block_q), int(block_k), interpret)
+
+
+def _tuned_hop_blocks(q, causal: bool, block_q, block_k):
+    """Per-hop block sizes: explicit values win; ``None`` consults the
+    ``"ring_flash"`` autotune entry for this (local block, heads, d,
+    dtype, causal) — banked by bench.py's hardware hop sweep — falling
+    back to 512².  Shared by the contiguous and zigzag fused kernels
+    (the hop programs fit blocks to their half/full extents anyway)."""
+    if block_q is not None and block_k is not None:
+        return block_q, block_k
+    from ..utils import autotune
+    tuned = autotune.get(
+        "ring_flash",
+        autotune.key_for(q.shape[0], q.shape[1], q.shape[2],
+                         q.dtype, causal))
+    tq = tk = 512
+    try:
+        a, b = (int(x) for x in tuned)
+        if a > 0 and b > 0:
+            tq, tk = a, b
+    except Exception:
+        pass
+    return (tq if block_q is None else block_q,
+            tk if block_k is None else block_k)
 
 
 @functools.lru_cache(maxsize=32)
@@ -301,8 +327,8 @@ def _ring_flash_jit(mesh, causal: bool, block_q: int, block_k: int):
 
 
 def ring_flash_attention(q: DArray, k: DArray, v: DArray,
-                         causal: bool = False, block_q: int = 512,
-                         block_k: int = 512) -> DArray:
+                         causal: bool = False, block_q: int | None = None,
+                         block_k: int | None = None) -> DArray:
     """Fused (Pallas per-hop) exact attention over sequence-sharded
     (seq, heads, d) DArrays — the performance path of ``ring_attention``."""
     for name, a in (("q", q), ("k", k), ("v", v)):
@@ -318,6 +344,8 @@ def ring_flash_attention(q: DArray, k: DArray, v: DArray,
             "ring attention needs the sequence dim sharded evenly over a "
             f"1-D grid; got grid {q.pids.shape} for dims {q.dims}")
     blk = q.dims[0] // n
+    lq = jax.ShapeDtypeStruct((blk, q.dims[1], q.dims[2]), q.dtype)
+    block_q, block_k = _tuned_hop_blocks(lq, bool(causal), block_q, block_k)
     bq = min(block_q, blk)
     bk = min(block_k, blk)
     while blk % bq:
@@ -651,8 +679,8 @@ _zigzag_flash_core.defvjp(_zigzag_flash_core_fwd, _zigzag_flash_core_bwd)
 
 def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
                                        scale: float | None = None,
-                                       block_q: int = 512,
-                                       block_k: int = 512,
+                                       block_q: int | None = None,
+                                       block_k: int | None = None,
                                        interpret: bool | None = None):
     """Fused zigzag ring attention: the quadrant schedule of
     ``zigzag_ring_attention_kernel`` with each computed quadrant running
@@ -663,6 +691,7 @@ def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
     re-runs the quadrant schedule with the FA2 recompute kernels, so
     load-balanced causal training also runs at Pallas speed.
     """
+    block_q, block_k = _tuned_hop_blocks(q, True, block_q, block_k)
     sc = None if scale is None else float(scale)
     return _zigzag_flash_core(q, k, v, axis, sc, int(block_q),
                               int(block_k), interpret)
@@ -683,8 +712,8 @@ def _zigzag_flash_jit(mesh, block_q: int, block_k: int):
 
 
 def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
-                                block_q: int = 512,
-                                block_k: int = 512) -> DArray:
+                                block_q: int | None = None,
+                                block_k: int | None = None) -> DArray:
     """Fused (Pallas per-quadrant) zigzag causal ring attention over
     zigzag-ordered sequence-sharded DArrays — the performance path of
     ``zigzag_ring_attention``."""
@@ -702,6 +731,11 @@ def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
             f"2*nranks over a 1-D grid; got grid {q.pids.shape} for dims "
             f"{q.dims}")
     half = q.dims[0] // (2 * n)
+    # None blocks: the registry default (keyed on the per-rank local
+    # block the kernel will see) before fitting to the half extent
+    lq = jax.ShapeDtypeStruct((q.dims[0] // n, q.dims[1], q.dims[2]),
+                              q.dtype)
+    block_q, block_k = _tuned_hop_blocks(lq, True, block_q, block_k)
     bq = min(block_q, half)
     bk = min(block_k, half)
     while half % bq:

@@ -58,8 +58,11 @@ class SPConfig(Config):
     ``(i, 2P-1-i)`` — feed tokens permuted by ``zigzag_order``)."""
 
     def __init__(self, vocab=256, dim=128, heads=4, layers=2, ffn_mult=4,
-                 max_seq=128, dtype=jnp.bfloat16, block_q=512, block_k=512,
+                 max_seq=128, dtype=jnp.bfloat16, block_q=None, block_k=None,
                  interpret=None, zigzag=False):
+        # block_q/block_k None = take the autotune registry's tuned hop
+        # blocks (banked by bench.py's hardware sweep), falling back to
+        # the kernel's 512 default
         super().__init__(vocab, dim, heads, layers, ffn_mult, max_seq,
                          dtype)
         self.block_q, self.block_k = block_q, block_k

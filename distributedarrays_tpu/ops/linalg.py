@@ -354,12 +354,12 @@ def dmatmul_int8(A, B, out_dtype=jnp.float32):
     this is an opt-in performance API, not a silently-degrading one.
     """
     if isinstance(A, (SubDArray,)):
-        A = A.copy()
+        A = A.materialize()      # route through the supported-layout pick
     if not isinstance(A, DArray):
-        # host arrays go straight onto a SUPPORTED layout (the default
-        # prime-factorized grid may be 2-D and would fail the check
-        # below): row-chunked when the rows divide the device count,
-        # single-device otherwise
+        # host/raw arrays go straight onto a SUPPORTED layout (the
+        # default prime-factorized grid may be 2-D and would fail the
+        # check below): row-chunked when the rows divide the device
+        # count, single-device otherwise
         av = jnp.asarray(A)
         ndev = len(L.all_ranks())
         if av.ndim == 2 and ndev > 1 and av.shape[0] % ndev == 0:

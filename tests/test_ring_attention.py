@@ -342,3 +342,37 @@ def test_zigzag_validation(rng):
     with pytest.raises(ValueError, match="2\\*nranks"):
         zigzag_ring_attention(d, d, d)
     dat.d_closeall()
+
+
+def test_ring_flash_blocks_from_registry(rng):
+    # unspecified blocks consult the "ring_flash" registry entry;
+    # malformed entries degrade to the 512 default — numerics identical
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from distributedarrays_tpu import layout as L
+    from distributedarrays_tpu.utils import autotune
+    from distributedarrays_tpu.models.ring_attention import (
+        ring_flash_attention_kernel, reference_attention)
+    B, H, D = 128, 2, 16
+    mesh = L.mesh_for([0], (1,))
+    ax = mesh.axis_names[0]
+    q = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
+
+    def run():
+        shm = jax.shard_map(
+            lambda a, b, c: ring_flash_attention_kernel(a, b, c, ax,
+                                                        causal=True),
+            mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax),
+            check_vma=False)
+        return np.asarray(shm(q, q, q))
+
+    want = reference_attention(np.asarray(q), np.asarray(q), np.asarray(q),
+                               causal=True)
+    autotune.clear()
+    autotune.record("ring_flash",
+                    autotune.key_for(B, H, D, q.dtype, True), (32, 64))
+    np.testing.assert_allclose(run(), want, rtol=2e-3, atol=2e-3)
+    autotune.record("ring_flash",
+                    autotune.key_for(B, H, D, q.dtype, True), "bogus")
+    np.testing.assert_allclose(run(), want, rtol=2e-3, atol=2e-3)
+    autotune.clear()
