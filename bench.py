@@ -752,11 +752,25 @@ def main():
             return _periter(run, L0=8, target_s=0.6)[0]
 
         best, sweep = autotune.sweep("ring_flash", key, cands, hop_timer)
+        # _tuned_hop_blocks keys on the PER-RANK local block, and a real
+        # P-rank ring sees SR/P — extrapolate the swept winner to the
+        # common ring sizes (the hop programs clip blocks to the local
+        # extent, so an oversized tuned block degrades gracefully);
+        # labeled extrapolated so nobody mistakes them for swept shapes
+        extrap = []
+        for rp in (2, 4, 8, 16, 32):
+            if SR % rp == 0 and SR // rp >= 512:
+                autotune.record("ring_flash",
+                                autotune.key_for(SR // rp, HR, DR,
+                                                 jnp.bfloat16(0).dtype,
+                                                 True), list(best))
+                extrap.append(SR // rp)
         autotune.save_default()
         t_fused = sweep[best]
         t_einsum, _ = _periter(ring_len(ring_attention_kernel), L0=4)
         return {"ring_hop_fused_8k_bf16_s": t_fused,
                 "ring_hop_tuned_block": list(best),
+                "ring_hop_tuned_extrapolated_to_local_blocks": extrap,
                 "ring_hop_sweep": {f"{bq}x{bk}": t
                                    for (bq, bk), t in sweep.items()},
                 "ring_hop_einsum_8k_bf16_s": t_einsum,

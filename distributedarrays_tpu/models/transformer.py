@@ -32,7 +32,7 @@ from ..ops.pallas_attention import flash_attention
 from .mlp import make_mesh
 
 __all__ = ["init_params", "forward", "loss_fn", "train_step",
-           "make_optax_train_step",
+           "make_optax_train_step", "generate",
            "shard_params", "make_mesh", "Config"]
 
 
@@ -170,6 +170,89 @@ def loss_fn(params, tokens, cfg: Config):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return -jnp.mean(ll)
+
+
+def _decode_attn(h, blk, heads, kc, vc, i, t, max_seq):
+    """One decode position through layer ``i``'s attention with the
+    stacked (L, B, max_seq, H, D) KV caches updated in place at ``t``.
+    Full-cache einsum with a position mask — the standard static-shape
+    decode step (small, memory-bound; the flash kernel is for prefill/
+    training shapes)."""
+    B, _, E = h.shape
+    D = E // heads
+    qkv = h @ blk["qkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, heads, D).astype(jnp.float32)
+    upd = lambda c, val: jax.lax.dynamic_update_slice(
+        c, val.reshape(1, B, 1, heads, D).astype(c.dtype), (i, 0, t, 0, 0))
+    kc, vc = upd(kc, k), upd(vc, v)
+    s = jnp.einsum("bhd,bkhd->bhk", q / np.sqrt(D),
+                   kc[i].astype(jnp.float32))
+    mask = jnp.arange(max_seq) <= t
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhk,bkhd->bhd", p, vc[i].astype(jnp.float32))
+    return (o.reshape(B, 1, E).astype(h.dtype) @ blk["proj"]), kc, vc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "n_new", "temperature"))
+def generate(params, prompt, n_new: int, cfg: Config,
+             temperature: float = 0.0, key=None):
+    """Autoregressive generation: ``n_new`` tokens appended to ``prompt``
+    (B, S0) int32, returned as (B, S0 + n_new).
+
+    The ENTIRE decode — prompt prefill (teacher-forced through the same
+    step) and generation — is one ``lax.scan`` under jit with per-layer
+    KV caches as the carry: static shapes, no per-token dispatch, no
+    Python in the loop.  ``temperature`` 0 = greedy argmax; > 0 samples
+    categorically (``key`` required).  Parameters keep their shardings,
+    so the tp/dp layouts of ``shard_params`` decode unchanged.
+    """
+    B, S0 = prompt.shape
+    total = S0 + n_new
+    if total > cfg.max_seq:
+        raise ValueError(f"prompt {S0} + n_new {n_new} exceeds max_seq "
+                         f"{cfg.max_seq}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    H, D = cfg.heads, cfg.dim // cfg.heads
+    Lb = cfg.layers
+    kc = jnp.zeros((Lb, B, cfg.max_seq, H, D), cfg.dtype)
+    vc = jnp.zeros_like(kc)
+    keys = (jax.random.split(key, max(total - 1, 1)) if key is not None
+            else jnp.zeros((max(total - 1, 1), 2), jnp.uint32))
+
+    def step(carry, inputs):
+        kc, vc, tok = carry
+        t, kt = inputs
+        x = (params["embed"][tok][:, None]
+             + params["pos"][t][None, None]).astype(cfg.dtype)
+        for i, blk in enumerate(params["blocks"]):
+            a, kc, vc = _decode_attn(_rmsnorm(x, blk["ln1"]), blk, H,
+                                     kc, vc, i, t, cfg.max_seq)
+            x = x + a
+            h2 = _rmsnorm(x, blk["ln2"])
+            x = x + jax.nn.gelu(h2 @ blk["w1"]) @ blk["w2"]
+        logits = (_rmsnorm(x[:, 0], params["ln_f"])
+                  @ params["head"]).astype(jnp.float32)      # (B, V)
+        if temperature > 0.0:
+            nxt = jax.random.categorical(kt, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(prompt.dtype)
+        # teacher-force while still inside the prompt (index capped at
+        # S0-1, so it never reads past the prompt)
+        nxt = jnp.where(t + 1 < S0, prompt[:, jnp.minimum(t + 1, S0 - 1)],
+                        nxt)
+        return (kc, vc, nxt), nxt
+
+    ts = jnp.arange(total - 1)
+    (_, _, _), toks = jax.lax.scan(step, (kc, vc, prompt[:, 0]),
+                                   (ts, keys[: total - 1]))
+    # toks[t] is the token at position t+1
+    return jnp.concatenate([prompt[:, :1], jnp.swapaxes(toks, 0, 1)],
+                           axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))

@@ -36,7 +36,11 @@ from ..ops.pallas_attention import (_dense_attention_shd as _dense_attention,
 
 
 @functools.lru_cache(maxsize=32)
-def _ulysses_jit(mesh, causal: bool, scale: float, use_flash: bool):
+def _ulysses_jit(mesh, causal: bool, scale: float, use_flash: bool,
+                 bq: int = 0, bk: int = 0, hfold: int = 1):
+    # blocks are resolved OUTSIDE this cache (ulysses_attention) and are
+    # part of the key — a tune banked after the first call must not be
+    # shadowed by a stale cached program
     axis = mesh.axis_names[0]
 
     def kernel(q, k, v):
@@ -51,9 +55,8 @@ def _ulysses_jit(mesh, causal: bool, scale: float, use_flash: bool):
             # per-rank compute = the Pallas flash kernel: no O(S^2) score
             # matrix, VMEM-resident online softmax
             from ..ops.pallas_attention import flash_attention
-            b = _flash_block(qh.shape[0])
             oh = flash_attention(qh, kh, vh, causal=causal, scale=scale,
-                                 block_q=b, block_k=b)
+                                 block_q=bq, block_k=bk, head_fold=hfold)
         else:
             oh = _dense_attention(qh, kh, vh, causal, scale)
         # inverse: scatter sequence, gather heads: (S, H/P, d) -> (S/P, H, d)
@@ -89,6 +92,21 @@ def ulysses_attention(q: DArray, k: DArray, v: DArray,
         raise ValueError(f"heads {H} must be divisible by {n} ranks")
     mesh = L.mesh_for(pids, (n, 1, 1))
     scale = float(1.0 / np.sqrt(D))
-    out = _ulysses_jit(mesh, bool(causal), scale, bool(use_flash))(
-        q.garray, k.garray, v.garray)
+    bq = bk = hf = 0
+    if use_flash:
+        # resolve the flash config HERE (registry or power-of-two
+        # fallback) so the cached jit is keyed on the resolved blocks
+        from ..ops.pallas_attention import tuned_flash_config
+        from ..utils import autotune
+        tuned = autotune.get(
+            "flash_attention",
+            autotune.key_for(S, H // n, D, q.dtype, bool(causal)))
+        if tuned is not None:
+            bq, bk, hf = tuned_flash_config(S, H // n, D, q.dtype,
+                                            bool(causal))
+        else:
+            bq = bk = _flash_block(S)
+            hf = 1
+    out = _ulysses_jit(mesh, bool(causal), scale, bool(use_flash),
+                       bq, bk, hf)(q.garray, k.garray, v.garray)
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])

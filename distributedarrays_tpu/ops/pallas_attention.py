@@ -41,7 +41,8 @@ except Exception:  # pragma: no cover
 
 from .pallas_gemm import _on_tpu
 
-__all__ = ["flash_attention", "flash_block_size", "flash_attention_hop",
+__all__ = ["flash_attention", "flash_block_size", "tuned_flash_config",
+           "flash_attention_hop",
            "flash_attention_hop_bwd", "flash_carry_init",
            "flash_carry_finalize"]
 
@@ -580,6 +581,39 @@ def _flash_bwd(causal, scale, bq, bk, interpret, hfold, res, g):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+def tuned_flash_config(S, H, D, dtype, causal: bool,
+                       block_q=None, block_k=None, head_fold=None):
+    """Resolve (block_q, block_k, head_fold) for a flash call: explicit
+    values win; ``None`` consults the autotune registry's entry for
+    (S, H, D, dtype, causal) — a 2- or 3-tuple — falling back to 512²/1.
+    The tuned head_fold was measured WITH the tuned blocks, so it is
+    grafted only when BOTH blocks also come from the registry.  A
+    malformed cache entry degrades to the defaults, never breaks
+    dispatch.  Callers that cache jitted programs must call this OUTSIDE
+    the cache and key on the resolved values (see models/ulysses.py) or
+    a later-banked tune would be silently ignored."""
+    if block_q is not None and block_k is not None and head_fold is not None:
+        return block_q, block_k, head_fold
+    from ..utils import autotune
+    tuned = autotune.get(
+        "flash_attention", autotune.key_for(S, H, D, dtype, bool(causal)))
+    tq = tk = 512
+    tf = 1
+    try:
+        vals = [int(x) for x in tuned]
+        if len(vals) in (2, 3) and all(x > 0 for x in vals):
+            tq, tk = vals[0], vals[1]
+            tf = vals[2] if len(vals) == 3 else 1
+    except Exception:
+        pass
+    use_tuned_fold = block_q is None and block_k is None
+    block_q = tq if block_q is None else block_q
+    block_k = tk if block_k is None else block_k
+    if head_fold is None:
+        head_fold = tf if use_tuned_fold else 1
+    return block_q, block_k, head_fold
+
+
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     block_q: int | None = None, block_k: int | None = None,
                     head_fold: int | None = None,
@@ -603,29 +637,8 @@ def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
         raise ValueError(f"q/k/v must share (S, H, D), got {q.shape}, "
                          f"{k.shape}, {v.shape}")
     S, H, D = q.shape
-    if block_q is None or block_k is None or head_fold is None:
-        from ..utils import autotune
-        tuned = autotune.get(
-            "flash_attention",
-            autotune.key_for(S, H, D, q.dtype, bool(causal)))
-        tq = tk = 512
-        tf = 1
-        try:   # a malformed cache entry degrades to the default, never
-            vals = [int(x) for x in tuned]          # breaks dispatch
-            if len(vals) in (2, 3) and all(x > 0 for x in vals):
-                tq, tk = vals[0], vals[1]
-                tf = vals[2] if len(vals) == 3 else 1
-        except Exception:
-            pass
-        # the tuned head_fold was measured WITH the tuned blocks — graft
-        # it only onto callers that take both blocks from the registry
-        # too; a caller pinning its own blocks gets hfold=1 unless it
-        # also pins head_fold
-        use_tuned_fold = block_q is None and block_k is None
-        block_q = tq if block_q is None else block_q
-        block_k = tk if block_k is None else block_k
-        if head_fold is None:
-            head_fold = tf if use_tuned_fold else 1
+    block_q, block_k, head_fold = tuned_flash_config(
+        S, H, D, q.dtype, bool(causal), block_q, block_k, head_fold)
     bq, bk = _fit_block(block_q, S), _fit_block(block_k, S)
     hfold = _fit_block(max(int(head_fold), 1), H)
     if interpret is None:

@@ -340,3 +340,44 @@ def test_transformer_optax_adamw_sharded_moments():
     mu_w1 = state[0].mu["blocks"][0]["w1"]
     assert mu_w1.dtype == jnp.float32
     assert _axes(mu_w1) == _axes(params["blocks"][0]["w1"]) == (None, "tp")
+
+
+# ---------------------------------------------------------------------------
+# round-4: KV-cache autoregressive generation
+# ---------------------------------------------------------------------------
+
+
+def test_generate_greedy_matches_forward():
+    # greedy decode must be self-consistent with the full forward: for
+    # every generated position, forward(seq)'s argmax at t equals seq[t+1]
+    cfg = T.Config(vocab=64, dim=32, heads=4, layers=2, max_seq=48,
+                   dtype=jnp.float32)
+    params = T.init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    seq = T.generate(params, prompt, 12, cfg)
+    assert seq.shape == (2, 20)
+    np.testing.assert_array_equal(np.asarray(seq[:, :8]),
+                                  np.asarray(prompt))
+    logits = T.forward(params, seq, cfg)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    for t in range(7, 19):        # generated region
+        np.testing.assert_array_equal(np.asarray(seq[:, t + 1]),
+                                      greedy[:, t], err_msg=str(t))
+
+
+def test_generate_sampling_and_validation():
+    cfg = T.Config(vocab=32, dim=16, heads=2, layers=1, max_seq=16,
+                   dtype=jnp.float32)
+    params = T.init_params(jax.random.key(2), cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    s1 = T.generate(params, prompt, 8, cfg, temperature=1.0,
+                    key=jax.random.key(3))
+    s2 = T.generate(params, prompt, 8, cfg, temperature=1.0,
+                    key=jax.random.key(4))
+    assert s1.shape == s2.shape == (1, 12)
+    assert (np.asarray(s1) != np.asarray(s2)).any()   # different keys
+    with pytest.raises(ValueError, match="max_seq"):
+        T.generate(params, prompt, 100, cfg)
+    with pytest.raises(ValueError, match="PRNG"):
+        T.generate(params, prompt, 4, cfg, temperature=0.5)
