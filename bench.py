@@ -392,6 +392,8 @@ def main():
         # the shape is part of the result's identity: an override run
         # (harness validation) must never read as headline-4096 numbers
         out = {"matmul_impl_tune_n": TN}
+        # each tuner persists its own winner the moment it lands (wedge
+        # resilience: a later tuner dying must not cost earlier spoils)
         for dt, tag in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
             winner, results = _la.tune_matmul_impl(
                 TN, TN, TN, dtype=dt, timer=chain_timer, persist=persist)
@@ -407,7 +409,7 @@ def main():
                     out[f"matmul_impl_dist_{impl}_s_per_iter"] = t
             out["matmul_impl_dist_winner"] = winner
         if persist:
-            out["matmul_impl_cache_path"] = autotune.save_default()
+            out["matmul_impl_cache_path"] = autotune.default_cache_path()
         return out
 
     _guarded(details, "matmul_impl_tune", cfg_matmul_impl_tune,

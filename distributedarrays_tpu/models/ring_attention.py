@@ -298,17 +298,11 @@ def _tuned_hop_blocks(q, causal: bool, block_q, block_k):
     if block_q is not None and block_k is not None:
         return block_q, block_k
     from ..utils import autotune
-    tuned = autotune.get(
-        "ring_flash",
-        autotune.key_for(q.shape[0], q.shape[1], q.shape[2],
-                         q.dtype, causal))
-    tq = tk = 512
-    try:
-        a, b = (int(x) for x in tuned)
-        if a > 0 and b > 0:
-            tq, tk = a, b
-    except Exception:
-        pass
+    vals = autotune.valid_ints(
+        autotune.get("ring_flash",
+                     autotune.key_for(q.shape[0], q.shape[1], q.shape[2],
+                                      q.dtype, causal)), (2,))
+    tq, tk = vals if vals else (512, 512)
     return (tq if block_q is None else block_q,
             tk if block_k is None else block_k)
 

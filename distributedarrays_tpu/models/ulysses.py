@@ -94,19 +94,13 @@ def ulysses_attention(q: DArray, k: DArray, v: DArray,
     scale = float(1.0 / np.sqrt(D))
     bq = bk = hf = 0
     if use_flash:
-        # resolve the flash config HERE (registry or power-of-two
-        # fallback) so the cached jit is keyed on the resolved blocks
+        # resolve the flash config HERE (registry, falling back to the
+        # always-valid power-of-two block) so the cached jit is keyed on
+        # the resolved values
         from ..ops.pallas_attention import tuned_flash_config
-        from ..utils import autotune
-        tuned = autotune.get(
-            "flash_attention",
-            autotune.key_for(S, H // n, D, q.dtype, bool(causal)))
-        if tuned is not None:
-            bq, bk, hf = tuned_flash_config(S, H // n, D, q.dtype,
-                                            bool(causal))
-        else:
-            bq = bk = _flash_block(S)
-            hf = 1
+        bq, bk, hf = tuned_flash_config(S, H // n, D, q.dtype,
+                                        bool(causal),
+                                        default=_flash_block(S))
     out = _ulysses_jit(mesh, bool(causal), scale, bool(use_flash),
                        bq, bk, hf)(q.garray, k.garray, v.garray)
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])

@@ -582,30 +582,27 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def tuned_flash_config(S, H, D, dtype, causal: bool,
-                       block_q=None, block_k=None, head_fold=None):
+                       block_q=None, block_k=None, head_fold=None,
+                       default: int = 512):
     """Resolve (block_q, block_k, head_fold) for a flash call: explicit
     values win; ``None`` consults the autotune registry's entry for
-    (S, H, D, dtype, causal) — a 2- or 3-tuple — falling back to 512²/1.
-    The tuned head_fold was measured WITH the tuned blocks, so it is
-    grafted only when BOTH blocks also come from the registry.  A
-    malformed cache entry degrades to the defaults, never breaks
-    dispatch.  Callers that cache jitted programs must call this OUTSIDE
-    the cache and key on the resolved values (see models/ulysses.py) or
-    a later-banked tune would be silently ignored."""
+    (S, H, D, dtype, causal) — a 2- or 3-tuple — falling back to
+    ``default``²/1.  The tuned head_fold was measured WITH the tuned
+    blocks, so it is grafted only when BOTH blocks also come from the
+    registry.  A malformed cache entry degrades to the defaults, never
+    breaks dispatch.  Callers that cache jitted programs must call this
+    OUTSIDE the cache and key on the resolved values (see
+    models/ulysses.py) or a later-banked tune would be silently
+    ignored."""
     if block_q is not None and block_k is not None and head_fold is not None:
         return block_q, block_k, head_fold
     from ..utils import autotune
-    tuned = autotune.get(
-        "flash_attention", autotune.key_for(S, H, D, dtype, bool(causal)))
-    tq = tk = 512
-    tf = 1
-    try:
-        vals = [int(x) for x in tuned]
-        if len(vals) in (2, 3) and all(x > 0 for x in vals):
-            tq, tk = vals[0], vals[1]
-            tf = vals[2] if len(vals) == 3 else 1
-    except Exception:
-        pass
+    vals = autotune.valid_ints(
+        autotune.get("flash_attention",
+                     autotune.key_for(S, H, D, dtype, bool(causal))),
+        (2, 3))
+    tq, tk = (vals[0], vals[1]) if vals else (default, default)
+    tf = vals[2] if vals and len(vals) == 3 else 1
     use_tuned_fold = block_q is None and block_k is None
     block_q = tq if block_q is None else block_q
     block_k = tk if block_k is None else block_k

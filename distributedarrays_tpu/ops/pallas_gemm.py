@@ -55,15 +55,14 @@ def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
 
     if block is None:
         from ..utils import autotune
-        tuned = autotune.get(kernel, autotune.key_for(m, n, k, *dtype_key))
-        try:
-            tm, tn, tk = (int(v) for v in tuned)
-            if (tm > 0 and tn > 0 and tk > 0
-                    and m % tm == 0 and n % tn == 0 and k % tk == 0
+        vals = autotune.valid_ints(
+            autotune.get(kernel, autotune.key_for(m, n, k, *dtype_key)),
+            (3,))
+        if vals is not None:
+            tm, tn, tk = vals
+            if (m % tm == 0 and n % tn == 0 and k % tk == 0
                     and (interpret or aligned(tm, tn, tk))):
                 block = (tm, tn, tk)
-        except Exception:
-            pass
     if block is None:
         bm0, bn0, bk0 = caps
 

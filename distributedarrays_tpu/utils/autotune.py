@@ -26,6 +26,7 @@ import threading
 from typing import Any, Callable, Iterable, Mapping
 
 __all__ = ["get", "record", "sweep", "save", "load", "clear", "key_for",
+           "valid_ints",
            "default_cache_path", "save_default"]
 
 _LOCK = threading.RLock()
@@ -36,6 +37,22 @@ _LOADED_ENV = False
 def key_for(*parts) -> str:
     """Canonical string key from shape/dtype/flag parts."""
     return "|".join(str(p) for p in parts)
+
+
+def valid_ints(entry, lengths: tuple[int, ...]):
+    """Parse a registry entry as a tuple of positive ints of an accepted
+    length, or None — a stale/hand-edited/malformed cache entry must
+    degrade to the caller's default, never break dispatch.  Shared by
+    every kernel that stores block tuples."""
+    if not isinstance(entry, (list, tuple)):
+        return None      # a string would "parse" via its characters
+    try:
+        vals = [int(x) for x in entry]
+        if len(vals) in lengths and all(v > 0 for v in vals):
+            return tuple(vals)
+    except Exception:
+        pass
+    return None
 
 
 def default_cache_path() -> str:
