@@ -745,12 +745,14 @@ def main():
         # "ring_flash" (consulted by ring_flash_attention_kernel when
         # blocks are unspecified — the sp-transformer's hot path)
         from distributedarrays_tpu.utils import autotune
-        cands = [(512, 512), (1024, 512), (1024, 1024), (2048, 1024)]
+        cands = [(512, 512), (1024, 512), (1024, 1024), (2048, 1024),
+                 (1024, 1024, 2), (1024, 1024, 4), (512, 512, 2)]
         key = autotune.key_for(SR, HR, DR, jnp.bfloat16(0).dtype, True)
 
         def hop_timer(cfg):
             run = ring_len(ring_flash_attention_kernel,
-                           block_q=cfg[0], block_k=cfg[1])
+                           block_q=cfg[0], block_k=cfg[1],
+                           head_fold=cfg[2] if len(cfg) > 2 else 1)
             return _periter(run, L0=8, target_s=0.6)[0]
 
         best, sweep = autotune.sweep("ring_flash", key, cands, hop_timer)
@@ -773,8 +775,9 @@ def main():
         return {"ring_hop_fused_8k_bf16_s": t_fused,
                 "ring_hop_tuned_block": list(best),
                 "ring_hop_tuned_extrapolated_to_local_blocks": extrap,
-                "ring_hop_sweep": {f"{bq}x{bk}": t
-                                   for (bq, bk), t in sweep.items()},
+                "ring_hop_sweep": {
+                    "x".join(str(v) for v in cfg): t
+                    for cfg, t in sweep.items()},
                 "ring_hop_einsum_8k_bf16_s": t_einsum,
                 "ring_hop_fused_speedup": t_einsum / t_fused}
 

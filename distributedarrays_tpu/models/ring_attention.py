@@ -149,7 +149,7 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
 
 
 def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,
-                         interpret):
+                         interpret, hfold=1):
     """Shared fused-ring forward.  Returns ``(out (b,h,d), oh (h,b,d),
     lse (h,b))`` — the latter two are the FA2 backward's residuals."""
     from ..ops.pallas_attention import (flash_attention_hop,
@@ -175,7 +175,7 @@ def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,
         return flash_attention_hop(qh, kc, vc, m, l, a, qoff, koff,
                                    causal=causal, scale=sc,
                                    block_q=block_q, block_k=block_k,
-                                   interpret=interpret)
+                                   head_fold=hfold, interpret=interpret)
 
     def body(step, carry):
         m, l, a, kc, vc = carry
@@ -190,23 +190,24 @@ def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,
     return jnp.transpose(oh, (1, 0, 2)), oh, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_flash_core(q, k, v, axis, causal, scale, block_q, block_k,
-                     interpret):
+                     interpret, hfold=1):
     out, _, _ = _ring_flash_fwd_loop(q, k, v, axis, causal, scale,
-                                     block_q, block_k, interpret)
+                                     block_q, block_k, interpret, hfold)
     return out
 
 
 def _ring_flash_core_fwd(q, k, v, axis, causal, scale, block_q, block_k,
-                         interpret):
+                         interpret, hfold=1):
     out, oh, lse = _ring_flash_fwd_loop(q, k, v, axis, causal, scale,
-                                        block_q, block_k, interpret)
+                                        block_q, block_k, interpret, hfold)
     return out, (q, k, v, oh, lse)
 
 
 def _ring_flash_core_bwd(axis, causal, scale, block_q, block_k, interpret,
-                         res, g):
+                         hfold, res, g):
     # FA2 ring backward: p = exp(s - lse) is exact given the FINAL lse, so
     # every (q block, k/v block) pair's gradient contribution is
     # independent and additive.  Mirror the forward's ring schedule: dq
@@ -268,6 +269,7 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
                                 scale: float | None = None,
                                 block_q: int | None = None,
                                 block_k: int | None = None,
+                                head_fold: int | None = None,
                                 interpret: bool | None = None):
     """Fused ring attention: each hop's blockwise accumulate is ONE Pallas
     flash program (VMEM-resident online softmax, no (h, b, b) score
@@ -283,38 +285,54 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
     accumulators with their blocks — sequence-parallel training runs at
     Pallas speed (VERDICT round-3 item 3).
     """
-    block_q, block_k = _tuned_hop_blocks(q, bool(causal), block_q, block_k)
+    block_q, block_k, hfold = _tuned_hop_blocks(
+        q, bool(causal), block_q, block_k)
+    if head_fold is not None:
+        hfold = head_fold
     sc = None if scale is None else float(scale)
     return _ring_flash_core(q, k, v, axis, bool(causal), sc,
-                            int(block_q), int(block_k), interpret)
+                            int(block_q), int(block_k), interpret,
+                            int(hfold))
 
 
-def _tuned_hop_blocks(q, causal: bool, block_q, block_k):
+def _tuned_hop_blocks(q, causal: bool, block_q, block_k,
+                      allow_fold: bool = True):
     """Per-hop block sizes: explicit values win; ``None`` consults the
     ``"ring_flash"`` autotune entry for this (local block, heads, d,
     dtype, causal) — banked by bench.py's hardware hop sweep — falling
     back to 512².  Shared by the contiguous and zigzag fused kernels
-    (the hop programs fit blocks to their half/full extents anyway)."""
+    (the hop programs fit blocks to their half/full extents anyway).
+    ``allow_fold=False`` (zigzag, whose quadrant kernel cannot fold
+    heads) refuses a FOLD-DEPENDENT entry entirely — blocks whose
+    measured win relied on hfold>1 must not be adopted without it."""
     if block_q is not None and block_k is not None:
-        return block_q, block_k
+        return block_q, block_k, 1
     from ..utils import autotune
     vals = autotune.valid_ints(
         autotune.get("ring_flash",
                      autotune.key_for(q.shape[0], q.shape[1], q.shape[2],
-                                      q.dtype, causal)), (2,))
-    tq, tk = vals if vals else (512, 512)
+                                      q.dtype, causal)), (2, 3))
+    if vals and len(vals) == 3 and vals[2] > 1 and not allow_fold:
+        vals = None
+    tq, tk = (vals[0], vals[1]) if vals else (512, 512)
+    # the tuned fold was measured WITH the tuned blocks (same policy as
+    # tuned_flash_config)
+    hf = vals[2] if (vals and len(vals) == 3
+                     and block_q is None and block_k is None) else 1
     return (tq if block_q is None else block_q,
-            tk if block_k is None else block_k)
+            tk if block_k is None else block_k, hf)
 
 
 @functools.lru_cache(maxsize=32)
-def _ring_flash_jit(mesh, causal: bool, block_q: int, block_k: int):
+def _ring_flash_jit(mesh, causal: bool, block_q: int, block_k: int,
+                    head_fold: int = 1):
     axis = mesh.axis_names[0]
     spec = P(axis, None, None)
 
     def fn(q, k, v):
         return ring_flash_attention_kernel(q, k, v, axis, causal=causal,
-                                           block_q=block_q, block_k=block_k)
+                                           block_q=block_q, block_k=block_k,
+                                           head_fold=head_fold)
 
     return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
                                  out_specs=spec, check_vma=False))
@@ -339,7 +357,8 @@ def ring_flash_attention(q: DArray, k: DArray, v: DArray,
             f"1-D grid; got grid {q.pids.shape} for dims {q.dims}")
     blk = q.dims[0] // n
     lq = jax.ShapeDtypeStruct((blk, q.dims[1], q.dims[2]), q.dtype)
-    block_q, block_k = _tuned_hop_blocks(lq, bool(causal), block_q, block_k)
+    block_q, block_k, hf = _tuned_hop_blocks(lq, bool(causal), block_q,
+                                             block_k)
     bq = min(block_q, blk)
     bk = min(block_k, blk)
     while blk % bq:
@@ -347,7 +366,8 @@ def ring_flash_attention(q: DArray, k: DArray, v: DArray,
     while blk % bk:
         bk //= 2
     mesh = L.mesh_for(pids, (n, 1, 1))
-    out = _ring_flash_jit(mesh, causal, bq, bk)(q.garray, k.garray, v.garray)
+    out = _ring_flash_jit(mesh, causal, bq, bk, hf)(
+        q.garray, k.garray, v.garray)
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])
 
 
@@ -685,7 +705,8 @@ def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
     re-runs the quadrant schedule with the FA2 recompute kernels, so
     load-balanced causal training also runs at Pallas speed.
     """
-    block_q, block_k = _tuned_hop_blocks(q, True, block_q, block_k)
+    block_q, block_k, _ = _tuned_hop_blocks(q, True, block_q, block_k,
+                                            allow_fold=False)
     sc = None if scale is None else float(scale)
     return _zigzag_flash_core(q, k, v, axis, sc, int(block_q),
                               int(block_k), interpret)
@@ -729,7 +750,8 @@ def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
     # block the kernel will see) before fitting to the half extent
     lq = jax.ShapeDtypeStruct((q.dims[0] // n, q.dims[1], q.dims[2]),
                               q.dtype)
-    block_q, block_k = _tuned_hop_blocks(lq, True, block_q, block_k)
+    block_q, block_k, _hf = _tuned_hop_blocks(lq, True, block_q, block_k,
+                                              allow_fold=False)
     bq = min(block_q, half)
     bk = min(block_k, half)
     while half % bq:

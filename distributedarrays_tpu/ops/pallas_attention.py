@@ -346,15 +346,16 @@ def _build_bwd(h, s, d, bq, bk, dtype_str, scale, causal, interpret,
 
 def _carry_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, m_in_ref,
                   l_in_ref, acc_in_ref, m_out_ref, l_out_ref, acc_out_ref,
-                  m_s, l_s, acc_s, *, scale, causal, bq, bk, k_steps):
+                  m_s, l_s, acc_s, *, scale, causal, bq, bk, k_steps,
+                  hfold):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
-        m_s[:] = m_in_ref[0][:, :1]
-        l_s[:] = l_in_ref[0][:, :1]
-        acc_s[:] = acc_in_ref[0]
+        m_s[:] = m_in_ref[:, :, :1]
+        l_s[:] = l_in_ref[:, :, :1]
+        acc_s[:] = acc_in_ref[:]
 
     if causal:
         # skip k blocks wholly after this q block's last row: on the hops
@@ -367,63 +368,70 @@ def _carry_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, m_in_ref,
 
     @pl.when(live)
     def _accumulate():
-        # native-dtype MXU passes with f32 accumulation (see _kernel)
-        q = q_ref[0]                                      # (bq, d)
-        k = k_ref[0]                                      # (bk, d)
-        v = v_ref[0]                                      # (bk, d)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        # native-dtype MXU passes with f32 accumulation; ``hfold`` heads
+        # ride each grid step as a batched dot (see _kernel)
+        q = q_ref[:]                                      # (hfold, bq, d)
+        k = k_ref[:]                                      # (hfold, bk, d)
+        v = v_ref[:]                                      # (hfold, bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # (hfold, bq, bk)
         if causal:
             qpos = qoff_ref[0] + qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
+                jnp.int32, (hfold, bq, bk), 1)
             kpos = koff_ref[0] + ki * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 1)
+                jnp.int32, (hfold, bq, bk), 2)
             s = jnp.where(kpos <= qpos, s, -jnp.inf)
 
         m_prev = m_s[:]
-        blk_max = jnp.max(s, axis=1, keepdims=True)
+        blk_max = jnp.max(s, axis=2, keepdims=True)
         m_new = jnp.maximum(m_prev, blk_max)
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - m_safe)
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
-        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
         acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         m_s[:] = m_new
 
     @pl.when(ki == k_steps - 1)
     def _flush():
-        m_out_ref[0] = jnp.broadcast_to(m_s[:], (bq, _LANE))
-        l_out_ref[0] = jnp.broadcast_to(l_s[:], (bq, _LANE))
-        acc_out_ref[0] = acc_s[:]
+        m_out_ref[:] = jnp.broadcast_to(m_s[:], (hfold, bq, _LANE))
+        l_out_ref[:] = jnp.broadcast_to(l_s[:], (hfold, bq, _LANE))
+        acc_out_ref[:] = acc_s[:]
 
 
 @functools.lru_cache(maxsize=64)
-def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret):
+def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret,
+                 hfold: int = 1):
     if pltpu is None:
         raise RuntimeError("pallas TPU namespace unavailable")
     k_steps = b // bk
     kern = functools.partial(_carry_kernel, scale=scale, causal=causal,
-                             bq=bq, bk=bk, k_steps=k_steps)
+                             bq=bq, bk=bk, k_steps=k_steps, hfold=hfold)
     call = pl.pallas_call(
         kern,
-        grid=(h, b // bq, k_steps),
+        grid=(h // hfold, b // bq, k_steps),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),                     # qoff
             pl.BlockSpec(memory_space=pltpu.SMEM),                     # koff
-            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # q
-            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # k
-            pl.BlockSpec((1, bk, d), lambda hh, qi, ki: (hh, ki, 0)),  # v
-            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),  # acc
+            pl.BlockSpec((hfold, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
+            pl.BlockSpec((hfold, bk, d), lambda hh, qi, ki: (hh, ki, 0)),
+            pl.BlockSpec((hfold, bq, _LANE),
+                         lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, _LANE),
+                         lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bq, _LANE), lambda hh, qi, ki: (hh, qi, 0)),
-            pl.BlockSpec((1, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, _LANE),
+                         lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, _LANE),
+                         lambda hh, qi, ki: (hh, qi, 0)),
+            pl.BlockSpec((hfold, bq, d), lambda hh, qi, ki: (hh, qi, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((h, b, _LANE), jnp.float32),
@@ -431,9 +439,9 @@ def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret):
             jax.ShapeDtypeStruct((h, b, d), jnp.float32),
         ),
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((hfold, bq, 1), jnp.float32),
+            pltpu.VMEM((hfold, bq, 1), jnp.float32),
+            pltpu.VMEM((hfold, bq, d), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -443,6 +451,7 @@ def _build_carry(h, b, d, bq, bk, dtype_str, scale, causal, interpret):
 def flash_attention_hop(q, k, v, m, l, acc, qoff, koff,
                         causal: bool = False, scale: float | None = None,
                         block_q: int = 512, block_k: int = 512,
+                        head_fold: int = 1,
                         interpret: bool | None = None):
     """One ring hop of flash attention with explicit online-softmax carry.
 
@@ -456,11 +465,12 @@ def flash_attention_hop(q, k, v, m, l, acc, qoff, koff,
     """
     H, B, D = q.shape
     bq, bk = _fit_block(block_q, B), _fit_block(block_k, B)
+    hfold = _fit_block(max(int(head_fold), 1), H)
     if interpret is None:
         interpret = not _on_tpu()
     sc = float(1.0 / np.sqrt(D) if scale is None else scale)
     call = _build_carry(H, B, D, bq, bk, str(q.dtype), sc, bool(causal),
-                        bool(interpret))
+                        bool(interpret), hfold)
     qo = jnp.asarray(qoff, jnp.int32).reshape(1)
     ko = jnp.asarray(koff, jnp.int32).reshape(1)
     return call(qo, ko, q, k, v, m, l, acc)
