@@ -870,7 +870,11 @@ def main():
             return _periter(pg_len, L0=8, target_s=0.6)[0]
 
         cands = [(1024, 1024, 512), (1024, 1024, 1024), (2048, 1024, 512),
-                 (1024, 2048, 512), (512, 1024, 1024), (2048, 2048, 256)]
+                 (1024, 2048, 512), (512, 1024, 1024), (2048, 2048, 256),
+                 # wider K streams (fewer acc flushes) and full-row tiles;
+                 # VMEM-overflow arms are skipped by the sweep's try/except
+                 (512, 512, 2048), (1024, 512, 2048), (2048, 2048, 512),
+                 (4096, 1024, 256), (1024, 4096, 256)]
         key = autotune.key_for(NP, NP, NP, ap.dtype, bp.dtype)
         best, results = autotune.sweep("pallas_matmul", key, cands, timer)
         autotune.save_default()
