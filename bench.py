@@ -1040,6 +1040,13 @@ def main():
 
     # cleanup may hang on a wedged tunnel: bounded (headline already out)
     _run_with_timeout(dat.d_closeall, 60)
+    if any(k.endswith("_orphan_running") for k in details):
+        # a wedged config left a daemon thread stuck inside the XLA
+        # runtime; normal interpreter teardown can SIGABRT through it.
+        # Everything is printed and persisted — exit hard and clean.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
 
 
 if __name__ == "__main__":
