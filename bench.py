@@ -481,7 +481,7 @@ def main():
         cands += [(1024, 1024, 2), (1024, 1024, 4), (2048, 1024, 2),
                   (512, 512, 2), (512, 512, 4)]
         key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, True)
-        best, results = autotune.sweep("flash_attention", key, cands, timer)
+        best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         cache = autotune.save_default()   # future processes pick this up
         flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
         out = {
@@ -495,7 +495,7 @@ def main():
                      flops / results[best] / 1e12, peak)
         return out
 
-    _guarded(details, "flash_attn_tune", cfg_flash_tune, timeout_s=600)
+    _guarded(details, "flash_attn_tune", cfg_flash_tune, timeout_s=900)
 
     # ---- extra: non-causal flash MFU (VERDICT round-3 item 5) ------------
     def cfg_flash_full():
@@ -525,7 +525,7 @@ def main():
                  (2048, 2048), (4096, 1024),
                  (1024, 1024, 2), (1024, 1024, 4), (2048, 1024, 2)]
         key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
-        best, results = autotune.sweep("flash_attention", key, cands, timer)
+        best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         autotune.save_default()
         flops = 2 * 2 * SQ * SQ * DQ * HQ        # full: no causal halving
         out = {"flash_attn_full_tuned_block": list(best),
@@ -536,7 +536,7 @@ def main():
                      flops / results[best] / 1e12, peak)
         return out
 
-    _guarded(details, "flash_attn_full", cfg_flash_full, timeout_s=600)
+    _guarded(details, "flash_attn_full", cfg_flash_full, timeout_s=900)
 
     # ---- extra: d=128 flash MFU (VERDICT round-3 item 5) -----------------
     # at d=64 BOTH flash matmuls carry a 64-wide dim (QK^T contracts over
@@ -570,7 +570,7 @@ def main():
                  (2048, 512), (2048, 1024),
                  (1024, 512, 2), (1024, 1024, 2), (2048, 1024, 2)]
         key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
-        best, results = autotune.sweep("flash_attention", key, cands, timer)
+        best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         autotune.save_default()
         flops = 2 * 2 * SQ * SQ * DQ * HQ
         out = {"flash_attn_d128_tuned_block": list(best),
@@ -755,7 +755,7 @@ def main():
                            head_fold=cfg[2] if len(cfg) > 2 else 1)
             return _periter(run, L0=8, target_s=0.6)[0]
 
-        best, sweep = autotune.sweep("ring_flash", key, cands, hop_timer)
+        best, sweep = autotune.sweep("ring_flash", key, cands, hop_timer, persist=True)
         # _tuned_hop_blocks keys on the PER-RANK local block, and a real
         # P-rank ring sees SR/P — extrapolate the swept winner to the
         # common ring sizes (the hop programs clip blocks to the local
@@ -876,7 +876,7 @@ def main():
                  (512, 512, 2048), (1024, 512, 2048), (2048, 2048, 512),
                  (4096, 1024, 256), (1024, 4096, 256)]
         key = autotune.key_for(NP, NP, NP, ap.dtype, bp.dtype)
-        best, results = autotune.sweep("pallas_matmul", key, cands, timer)
+        best, results = autotune.sweep("pallas_matmul", key, cands, timer, persist=True)
         autotune.save_default()
         out = {
             "pallas_gemm_tuned_block": list(best),

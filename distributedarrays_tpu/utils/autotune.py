@@ -137,7 +137,8 @@ def load(path: str) -> None:
 
 def sweep(kernel: str, key: str, candidates: Iterable,
           timer: Callable[[Any], float],
-          record_best: bool = True) -> tuple[Any, Mapping[Any, float]]:
+          record_best: bool = True,
+          persist: bool = False) -> tuple[Any, Mapping[Any, float]]:
     """Time every candidate config with ``timer(config) -> seconds``
     (lower is better), record the winner in the registry, and return
     ``(best_config, {config: seconds})``.
@@ -145,18 +146,29 @@ def sweep(kernel: str, key: str, candidates: Iterable,
     A candidate whose timer raises is skipped (an invalid tiling for the
     shape is an expected outcome, not an error); if every candidate
     fails, the last exception propagates.
+
+    The best-so-far is recorded after EVERY candidate (not just at the
+    end), and with ``persist=True`` also written to the default cache
+    file each time it improves: a sweep killed mid-run by a watchdog —
+    the normal fate of a long hardware sweep through a wedging tunnel —
+    still banks the best configuration it measured, on disk.
     """
     results: dict[Any, float] = {}
     last_exc = None
+    best = None
     for cfg in candidates:
         try:
             results[cfg] = float(timer(cfg))
         except Exception as e:  # invalid tiling / VMEM overflow / ...
             last_exc = e
+            continue
+        if best is None or results[cfg] < results[best]:
+            best = cfg
+            if record_best:
+                record(kernel, key, best)
+                if persist:
+                    save_default()
     if not results:
         raise last_exc if last_exc is not None else \
             ValueError("sweep got no candidates")
-    best = min(results, key=results.get)
-    if record_best:
-        record(kernel, key, best)
     return best, results
