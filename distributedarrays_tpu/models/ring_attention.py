@@ -295,16 +295,14 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
                             int(hfold))
 
 
-def _tuned_hop_blocks(q, causal: bool, block_q, block_k,
-                      allow_fold: bool = True):
+def _tuned_hop_blocks(q, causal: bool, block_q, block_k):
     """Per-hop block sizes: explicit values win; ``None`` consults the
     ``"ring_flash"`` autotune entry for this (local block, heads, d,
     dtype, causal) — banked by bench.py's hardware hop sweep — falling
     back to 512².  Shared by the contiguous and zigzag fused kernels
-    (the hop programs fit blocks to their half/full extents anyway).
-    ``allow_fold=False`` (zigzag, whose quadrant kernel cannot fold
-    heads) refuses a FOLD-DEPENDENT entry entirely — blocks whose
-    measured win relied on hfold>1 must not be adopted without it."""
+    (the hop programs fit blocks to their half/full extents anyway;
+    both thread a 3-tuple entry's head fold through
+    ``flash_attention_hop``)."""
     if block_q is not None and block_k is not None:
         return block_q, block_k, 1
     from ..utils import autotune
@@ -312,8 +310,6 @@ def _tuned_hop_blocks(q, causal: bool, block_q, block_k,
         autotune.get("ring_flash",
                      autotune.key_for(q.shape[0], q.shape[1], q.shape[2],
                                       q.dtype, causal)), (2, 3))
-    if vals and len(vals) == 3 and vals[2] > 1 and not allow_fold:
-        vals = None
     tq, tk = (vals[0], vals[1]) if vals else (512, 512)
     # the tuned fold was measured WITH the tuned blocks (same policy as
     # tuned_flash_config)
@@ -495,7 +491,7 @@ def zigzag_ring_attention_kernel(q, k, v, axis: str,
 
 
 def _zigzag_flash_fwd_loop(q, k, v, axis, scale, block_q, block_k,
-                           interpret):
+                           interpret, hfold=1):
     """Shared fused-zigzag forward.  Returns ``(out (b,h,d), oh (h,b,d),
     lse (h,b))`` with the two half-chunks concatenated on the row axis."""
     from ..ops.pallas_attention import (flash_attention_hop,
@@ -522,7 +518,7 @@ def _zigzag_flash_fwd_loop(q, k, v, axis, scale, block_q, block_k,
         return flash_attention_hop(qx, kx, vx, m, l, a, qoff, koff,
                                    causal=causal_, scale=sc,
                                    block_q=block_q, block_k=block_k,
-                                   interpret=interpret)
+                                   head_fold=hfold, interpret=interpret)
 
     init = flash_carry_init(h, half, dh)
 
@@ -571,21 +567,24 @@ def _zigzag_flash_fwd_loop(q, k, v, axis, scale, block_q, block_k,
     return jnp.transpose(oh, (1, 0, 2)), oh, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _zigzag_flash_core(q, k, v, axis, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _zigzag_flash_core(q, k, v, axis, scale, block_q, block_k, interpret,
+                       hfold=1):
     out, _, _ = _zigzag_flash_fwd_loop(q, k, v, axis, scale,
-                                       block_q, block_k, interpret)
+                                       block_q, block_k, interpret, hfold)
     return out
 
 
 def _zigzag_flash_core_fwd(q, k, v, axis, scale, block_q, block_k,
-                           interpret):
+                           interpret, hfold=1):
     out, oh, lse = _zigzag_flash_fwd_loop(q, k, v, axis, scale,
-                                          block_q, block_k, interpret)
+                                          block_q, block_k, interpret,
+                                          hfold)
     return out, (q, k, v, oh, lse)
 
 
-def _zigzag_flash_core_bwd(axis, scale, block_q, block_k, interpret, res, g):
+def _zigzag_flash_core_bwd(axis, scale, block_q, block_k, interpret, hfold,
+                           res, g):
     # the ring FA2 backward (see _ring_flash_core_bwd) specialized to the
     # zigzag quadrant schedule: each hop re-runs exactly the quadrants the
     # forward computed (the same lax.switch on sign(src - me)), adding
@@ -695,6 +694,7 @@ def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
                                        scale: float | None = None,
                                        block_q: int | None = None,
                                        block_k: int | None = None,
+                                       head_fold: int | None = None,
                                        interpret: bool | None = None):
     """Fused zigzag ring attention: the quadrant schedule of
     ``zigzag_ring_attention_kernel`` with each computed quadrant running
@@ -705,22 +705,25 @@ def zigzag_ring_flash_attention_kernel(q, k, v, axis: str,
     re-runs the quadrant schedule with the FA2 recompute kernels, so
     load-balanced causal training also runs at Pallas speed.
     """
-    block_q, block_k, _ = _tuned_hop_blocks(q, True, block_q, block_k,
-                                            allow_fold=False)
+    block_q, block_k, hfold = _tuned_hop_blocks(q, True, block_q, block_k)
+    if head_fold is not None:
+        hfold = head_fold
     sc = None if scale is None else float(scale)
     return _zigzag_flash_core(q, k, v, axis, sc, int(block_q),
-                              int(block_k), interpret)
+                              int(block_k), interpret, int(hfold))
 
 
 @functools.lru_cache(maxsize=32)
-def _zigzag_flash_jit(mesh, block_q: int, block_k: int):
+def _zigzag_flash_jit(mesh, block_q: int, block_k: int,
+                      head_fold: int = 1):
     axis = mesh.axis_names[0]
     spec = P(axis, None, None)
 
     def fn(q, k, v):
         return zigzag_ring_flash_attention_kernel(q, k, v, axis,
                                                   block_q=block_q,
-                                                  block_k=block_k)
+                                                  block_k=block_k,
+                                                  head_fold=head_fold)
 
     return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
                                  out_specs=spec, check_vma=False))
@@ -750,8 +753,7 @@ def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
     # block the kernel will see) before fitting to the half extent
     lq = jax.ShapeDtypeStruct((q.dims[0] // n, q.dims[1], q.dims[2]),
                               q.dtype)
-    block_q, block_k, _hf = _tuned_hop_blocks(lq, True, block_q, block_k,
-                                              allow_fold=False)
+    block_q, block_k, zhf = _tuned_hop_blocks(lq, True, block_q, block_k)
     bq = min(block_q, half)
     bk = min(block_k, half)
     while half % bq:
@@ -759,7 +761,8 @@ def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
     while half % bk:
         bk //= 2
     mesh = L.mesh_for(pids, (n, 1, 1))
-    out = _zigzag_flash_jit(mesh, bq, bk)(q.garray, k.garray, v.garray)
+    out = _zigzag_flash_jit(mesh, bq, bk, zhf)(
+        q.garray, k.garray, v.garray)
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])
 
 

@@ -425,3 +425,38 @@ def test_ring_flash_head_fold_matches(rng):
     np.testing.assert_allclose(np.asarray(g2), np.asarray(g1),
                                rtol=1e-4, atol=1e-5)
     autotune.clear()
+
+
+def test_zigzag_flash_head_fold_matches(rng):
+    # round-4: the zigzag quadrant schedule threads the tuned fold
+    # through its half-block hops — numerics and grads identical
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from distributedarrays_tpu import layout as L
+    from distributedarrays_tpu.utils import autotune
+    from distributedarrays_tpu.models.ring_attention import (
+        zigzag_ring_flash_attention_kernel)
+    B, H, D = 64, 4, 16
+    mesh = L.mesh_for([0], (1,))
+    ax = mesh.axis_names[0]
+    q = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
+
+    def run(a):
+        shm = jax.shard_map(
+            lambda x, b, c: zigzag_ring_flash_attention_kernel(
+                x, b, c, ax), mesh=mesh, in_specs=(P(ax),) * 3,
+            out_specs=P(ax), check_vma=False)
+        return shm(a, q, q)
+
+    autotune.clear()
+    key = autotune.key_for(B, H, D, q.dtype, True)
+    autotune.record("ring_flash", key, (16, 16))
+    base = np.asarray(run(q))
+    gbase = jax.grad(lambda a: jnp.sum(run(a) ** 2))(q)
+    autotune.record("ring_flash", key, (16, 16, 2))
+    folded = np.asarray(run(q))
+    gfold = jax.grad(lambda a: jnp.sum(run(a) ** 2))(q)
+    np.testing.assert_allclose(folded, base, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gfold), np.asarray(gbase),
+                               rtol=1e-4, atol=1e-5)
+    autotune.clear()
