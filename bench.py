@@ -408,6 +408,25 @@ def main():
                 if t != float("inf"):
                     out[f"matmul_impl_dist_{impl}_s_per_iter"] = t
             out["matmul_impl_dist_winner"] = winner
+        if len(jax.devices()) >= 4:
+            # the 2-D-grid arm (BASELINE config 3's block layout): GSPMD
+            # vs the Cannon double ring on the largest power-of-two
+            # square grid the devices support (an odd isqrt like 5 would
+            # not divide the shape and would crash this section's
+            # already-collected results), at the 16384² config's shape
+            # (scaled by the harness override, rounded to a g multiple)
+            gq = 2
+            while (2 * gq) ** 2 <= len(jax.devices()):
+                gq *= 2
+            TS = int(os.environ.get("DAT_BENCH_TUNE_N", 4 * N))
+            TS -= TS % gq
+            winner, results = _la.tune_matmul_impl_summa(
+                TS, TS, TS, g=gq, timer=chain_timer, persist=persist)
+            for impl, t in results.items():
+                if t != float("inf"):
+                    out[f"matmul_impl_summa_{gq}x{gq}_{impl}_s_per_iter"] = t
+            out[f"matmul_impl_summa_{gq}x{gq}_winner"] = winner
+            out["matmul_impl_summa_n"] = TS
         if persist:
             out["matmul_impl_cache_path"] = autotune.default_cache_path()
         return out
@@ -480,7 +499,7 @@ def main():
         # 64, so this tunes scheduling, not the MXU ceiling)
         cands += [(1024, 1024, 2), (1024, 1024, 4), (2048, 1024, 2),
                   (512, 512, 2), (512, 512, 4)]
-        key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, True)
+        key = autotune.device_key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, True)
         best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         cache = autotune.save_default()   # future processes pick this up
         flops = 2 * 2 * SQ * SQ * DQ * HQ / 2
@@ -524,7 +543,7 @@ def main():
         cands = [(512, 512), (1024, 1024), (2048, 1024), (1024, 2048),
                  (2048, 2048), (4096, 1024),
                  (1024, 1024, 2), (1024, 1024, 4), (2048, 1024, 2)]
-        key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
+        key = autotune.device_key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
         best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         autotune.save_default()
         flops = 2 * 2 * SQ * SQ * DQ * HQ        # full: no causal halving
@@ -569,7 +588,7 @@ def main():
         cands = [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
                  (2048, 512), (2048, 1024),
                  (1024, 512, 2), (1024, 1024, 2), (2048, 1024, 2)]
-        key = autotune.key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
+        key = autotune.device_key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
         best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         autotune.save_default()
         flops = 2 * 2 * SQ * SQ * DQ * HQ
@@ -747,7 +766,7 @@ def main():
         from distributedarrays_tpu.utils import autotune
         cands = [(512, 512), (1024, 512), (1024, 1024), (2048, 1024),
                  (1024, 1024, 2), (1024, 1024, 4), (512, 512, 2)]
-        key = autotune.key_for(SR, HR, DR, jnp.bfloat16(0).dtype, True)
+        key = autotune.device_key_for(SR, HR, DR, jnp.bfloat16(0).dtype, True)
 
         def hop_timer(cfg):
             run = ring_len(ring_flash_attention_kernel,
@@ -765,9 +784,10 @@ def main():
         for rp in (2, 4, 8, 16, 32):
             if SR % rp == 0 and SR // rp >= 512:
                 autotune.record("ring_flash",
-                                autotune.key_for(SR // rp, HR, DR,
-                                                 jnp.bfloat16(0).dtype,
-                                                 True), list(best))
+                                autotune.device_key_for(
+                                    SR // rp, HR, DR,
+                                    jnp.bfloat16(0).dtype, True),
+                                list(best))
                 extrap.append(SR // rp)
         autotune.save_default()
         t_fused = sweep[best]
@@ -875,7 +895,7 @@ def main():
                  # VMEM-overflow arms are skipped by the sweep's try/except
                  (512, 512, 2048), (1024, 512, 2048), (2048, 2048, 512),
                  (4096, 1024, 256), (1024, 4096, 256)]
-        key = autotune.key_for(NP, NP, NP, ap.dtype, bp.dtype)
+        key = autotune.device_key_for(NP, NP, NP, ap.dtype, bp.dtype)
         best, results = autotune.sweep("pallas_matmul", key, cands, timer, persist=True)
         autotune.save_default()
         out = {
@@ -999,6 +1019,92 @@ def main():
 
     _guarded(details, "transformer_train", cfg_transformer_train,
              timeout_s=600)
+
+    # ---- extra: sp-transformer train step + KV-cache decode --------------
+    # The composed flagship perf story (VERDICT round-4 item 7): the
+    # explicit-SPMD sequence-parallel model (ring flash attention +
+    # tp_ffn) timed as train tokens/sec with model-FLOPs MFU, plus the
+    # KV-cache decode step.  On one chip the ring is 1-rank (hop-free)
+    # — still the full composed program; multi-chip scaling is covered
+    # by the dryrun/CPU-mesh legs until a multi-chip window exists.
+    def cfg_sp_train():
+        from distributedarrays_tpu.models import sp_transformer as SPT
+        from distributedarrays_tpu.parallel import collectives as C_
+        p_ = len(jax.devices())
+        mesh = C_.spmd_mesh(p_)
+        SV, SE, SH, SL = 8192, 1024, 16, 8
+        SS = int(os.environ.get("DAT_BENCH_SP_SEQ", 8192))
+        cfg = SPT.SPConfig(vocab=SV, dim=SE, heads=SH, layers=SL,
+                           ffn_mult=4, max_seq=SS, dtype=jnp.bfloat16)
+        params = SPT.init_params(jax.random.key(0), cfg)
+        Bt = 1
+        toks = jax.random.randint(jax.random.key(1), (Bt, SS), 0, SV,
+                                  dtype=jnp.int32)
+        lr = jnp.float32(1e-4)
+        # resolve the tuned hop blocks OUTSIDE the chain jit (the
+        # sp_transformer contract) so a tune banked earlier in this run
+        # is what gets timed
+        rcfg = SPT._resolve_cfg(cfg, mesh, "p", toks.shape)
+        grad_fn = SPT._grad_program(mesh, rcfg, "p")
+
+        def steps_len(L):
+            @jax.jit
+            def f(prm):
+                def body(prm, _):
+                    loss, g = grad_fn(prm, toks)
+                    prm = jax.tree_util.tree_map(
+                        lambda w, gg: (w.astype(jnp.float32)
+                                       - lr * gg.astype(jnp.float32))
+                        .astype(w.dtype), prm, g)
+                    return prm, loss
+                prm, losses = lax.scan(body, prm, None, length=L)
+                return losses[-1]
+            float(f(params))
+            return min(_t(lambda: float(f(params))) for _ in range(2))
+
+        t_step, L = _periter(steps_len, L0=2)
+        nparams = sum(int(np.prod(x.shape))
+                      for x in jax.tree_util.tree_leaves(params))
+        Dh = SE // SH
+        # model FLOPs: 6*params per token (fwd+bwd matmuls) + causal
+        # flash attention (fwd QK^T+PV pair, bwd 2.5x -> 3.5x, /2 causal)
+        flops = (6 * nparams * Bt * SS
+                 + 3.5 * SL * (2 * 2 * SS * SS * Dh * SH) / 2 * Bt)
+        out = {
+            "sp_train_step_s": t_step,
+            "sp_train_seq": SS,
+            "sp_train_tokens_per_s": Bt * SS / t_step,
+            "sp_train_params": nparams,
+            "sp_train_hop_blocks": [rcfg.block_q, rcfg.block_k,
+                                    rcfg.head_fold],
+        }
+        _bank_tflops(out, "sp_train_model", flops / t_step / 1e12, peak)
+        return out
+
+    _guarded(details, "sp_train", cfg_sp_train, timeout_s=900)
+
+    def cfg_decode():
+        from distributedarrays_tpu.models import transformer as T
+        cfg = T.Config(vocab=8192, dim=1024, heads=16, layers=8,
+                       ffn_mult=4, max_seq=2048, dtype=jnp.bfloat16)
+        params = T.init_params(jax.random.key(2), cfg)
+        Bd, S0, NEW = 8, 16, 2032 - 16
+        prompt = jax.random.randint(jax.random.key(3), (Bd, S0), 0,
+                                    cfg.vocab, dtype=jnp.int32)
+
+        def run():
+            outt = T.generate(params, prompt, NEW, cfg)
+            return float(jnp.sum(outt[:, -1]))   # scalar fetch = sync
+
+        run()                                    # compile
+        t_dec = min(_t(run) for _ in range(2))
+        steps = S0 + NEW - 1                     # scan length (prefill+gen)
+        return {"decode_kvcache_total_s": t_dec,
+                "decode_kvcache_tokens_per_s": Bd * steps / t_dec,
+                "decode_kvcache_batch": Bd,
+                "decode_kvcache_steps": steps}
+
+    _guarded(details, "decode_kvcache", cfg_decode, timeout_s=600)
 
     # ---- extra: distributed sort over 1e7 elements -----------------------
     def cfg_sort():

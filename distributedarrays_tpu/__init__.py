@@ -31,7 +31,7 @@ from .ops.fft import dfft, difft, dfft2, difft2
 from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
                          rmul_diag, matmul, mul_into, dtranspose, dadjoint,
                          tune_matmul_impl, tune_matmul_impl_dist,
-                         dmatmul_int8)
+                         tune_matmul_impl_summa, dmatmul_int8)
 from .ops.sort import dsort
 from .ops.sparse import dnnz, ddata_bcoo
 from . import parallel

@@ -509,7 +509,16 @@ class DArray:
 
     def _gather_host(self):
         self._check_open()
-        return jax.device_get(self.garray)
+        g = self.garray
+        if not g.is_fully_addressable:
+            # process-spanning array: jax.device_get would raise jax's
+            # opaque non-addressable RuntimeError.  Route through the
+            # symmetric multi-controller gather instead — legitimate
+            # under SPMD discipline (every process executes the same
+            # program, so every process is inside this same call)
+            from .parallel import multihost
+            return multihost.gather_global(g)
+        return jax.device_get(g)
 
     def _mutate(self, updater):
         """Atomic read-modify-write of the backing buffer: every partial

@@ -204,6 +204,13 @@ def mesh_for(pids: Sequence[int], chunks: Sequence[int]) -> Mesh:
     use = tuple(int(p) for p in pids[:need])
     if len(use) < need:
         raise ValueError(f"layout {chunks} needs {need} ranks, got {len(pids)}")
+    ndev = len(jax.devices())
+    bad = [p for p in use if not 0 <= p < ndev]
+    if bad:
+        # a raw numpy IndexError here would leak the indexing internals;
+        # surface the same rank-validation family as the count check
+        raise ValueError(
+            f"rank ids {bad} out of range: only {ndev} devices visible")
     key = (use, chunks)
     with _mesh_lock:
         m = _mesh_cache.get(key)

@@ -46,7 +46,7 @@ __all__ = ["ring_attention", "ring_attention_kernel",
            "zigzag_ring_flash_attention",
            "zigzag_ring_flash_attention_kernel",
            "zigzag_order", "zigzag_shard", "zigzag_unshard",
-           "reference_attention"]
+           "tuned_hop_blocks_for", "reference_attention"]
 
 
 def _online_accumulate(m, l, o, qf, kc, vc, mask=None):
@@ -296,20 +296,31 @@ def ring_flash_attention_kernel(q, k, v, axis: str, causal: bool = False,
 
 
 def _tuned_hop_blocks(q, causal: bool, block_q, block_k):
+    """Per-hop block sizes for an actual (local block, heads, d) array —
+    see ``tuned_hop_blocks_for``."""
+    return tuned_hop_blocks_for(q.shape, q.dtype, causal, block_q, block_k)
+
+
+def tuned_hop_blocks_for(shape, dtype, causal: bool, block_q, block_k):
     """Per-hop block sizes: explicit values win; ``None`` consults the
     ``"ring_flash"`` autotune entry for this (local block, heads, d,
     dtype, causal) — banked by bench.py's hardware hop sweep — falling
     back to 512².  Shared by the contiguous and zigzag fused kernels
     (the hop programs fit blocks to their half/full extents anyway;
     both thread a 3-tuple entry's head fold through
-    ``flash_attention_hop``)."""
+    ``flash_attention_hop``).  Callers that cache jitted programs must
+    resolve through here OUTSIDE the cache and key on the resolved
+    values (see models/sp_transformer._resolve_cfg) — resolving at trace
+    time inside a cached program silently pins the registry's state at
+    first trace."""
     if block_q is not None and block_k is not None:
         return block_q, block_k, 1
     from ..utils import autotune
     vals = autotune.valid_ints(
         autotune.get("ring_flash",
-                     autotune.key_for(q.shape[0], q.shape[1], q.shape[2],
-                                      q.dtype, causal)), (2, 3))
+                     autotune.device_key_for(shape[0], shape[1],
+                                             shape[2], dtype, causal)),
+        (2, 3))
     tq, tk = (vals[0], vals[1]) if vals else (512, 512)
     # the tuned fold was measured WITH the tuned blocks (same policy as
     # tuned_flash_config)

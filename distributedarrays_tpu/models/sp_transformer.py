@@ -59,19 +59,23 @@ class SPConfig(Config):
 
     def __init__(self, vocab=256, dim=128, heads=4, layers=2, ffn_mult=4,
                  max_seq=128, dtype=jnp.bfloat16, block_q=None, block_k=None,
-                 interpret=None, zigzag=False):
-        # block_q/block_k None = take the autotune registry's tuned hop
-        # blocks (banked by bench.py's hardware sweep), falling back to
-        # the kernel's 512 default
+                 interpret=None, zigzag=False, head_fold=None):
+        # block_q/block_k/head_fold None = take the autotune registry's
+        # tuned hop config (banked by bench.py's hardware sweep), falling
+        # back to the kernel's 512²/1 default.  The train-step factories
+        # resolve the Nones OUTSIDE their cached jits (``_resolve_cfg``)
+        # so a tune banked after the first step is picked up, not
+        # silently pinned at first trace (ADVICE round-4).
         super().__init__(vocab, dim, heads, layers, ffn_mult, max_seq,
                          dtype)
         self.block_q, self.block_k = block_q, block_k
+        self.head_fold = head_fold
         self.interpret = interpret
         self.zigzag = bool(zigzag)
 
     def _key(self):
-        return super()._key() + (self.block_q, self.block_k, self.interpret,
-                                 self.zigzag)
+        return super()._key() + (self.block_q, self.block_k, self.head_fold,
+                                 self.interpret, self.zigzag)
 
 
 def init_params(key, cfg: SPConfig):
@@ -139,12 +143,12 @@ def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
             o = zigzag_ring_flash_attention_kernel(
                 fold(q), fold(k), fold(v), axis,
                 block_q=cfg.block_q, block_k=cfg.block_k,
-                interpret=cfg.interpret)
+                head_fold=cfg.head_fold, interpret=cfg.interpret)
         else:
             o = ring_flash_attention_kernel(
                 fold(q), fold(k), fold(v), axis, causal=True,
                 block_q=cfg.block_q, block_k=cfg.block_k,
-                interpret=cfg.interpret)
+                head_fold=cfg.head_fold, interpret=cfg.interpret)
         o = jnp.transpose(o.reshape(S_loc, Bt, H, D),
                           (1, 0, 2, 3)).reshape(Bt, S_loc, E)
         x = x + o @ blk["proj"]
@@ -205,11 +209,53 @@ def loss_local(params, tokens_loc, cfg: SPConfig, axis: str):
     return lax.psum(_loss_partial(params, tokens_loc, cfg, axis), axis)
 
 
+def _resolve_cfg(cfg: SPConfig, mesh, axis: str, tokens_shape) -> SPConfig:
+    """Resolve ``None`` hop knobs against the autotune registry OUTSIDE
+    any cached jit: returns an SPConfig whose block_q/block_k/head_fold
+    are concrete, suitable as a program-cache key.  Resolving at trace
+    time inside a cached step would pin the registry's state at first
+    trace — a tune banked after step 1 would be silently ignored for the
+    life of the program (ADVICE round-4; same contract as
+    ``tuned_flash_config`` / models/ulysses.py)."""
+    if (cfg.block_q is not None and cfg.block_k is not None
+            and cfg.head_fold is not None):
+        return cfg
+    from .ring_attention import tuned_hop_blocks_for
+    B, S = tokens_shape
+    p = mesh.shape[axis]
+    # forward_local's fold: q is (s_loc, b*heads, head_dim) in cfg.dtype;
+    # both ring layouts tune under causal=True
+    shape = (S // p, B * cfg.heads, cfg.dim // cfg.heads)
+    bq, bk, hf = tuned_hop_blocks_for(shape, jnp.dtype(cfg.dtype), True,
+                                      cfg.block_q, cfg.block_k)
+    if cfg.head_fold is not None:
+        hf = cfg.head_fold
+    return SPConfig(cfg.vocab, cfg.dim, cfg.heads, cfg.layers,
+                    cfg.ffn_mult, cfg.max_seq, cfg.dtype,
+                    block_q=int(bq), block_k=int(bk),
+                    interpret=cfg.interpret, zigzag=cfg.zigzag,
+                    head_fold=int(hf))
+
+
 def make_grad_fn(mesh, cfg: SPConfig, axis: str = "p"):
-    """The shard_map (loss, grads) program shared by both train steps:
-    tokens sharded ``(b, s/p)``, replicated-param grads psum'd
-    EXPLICITLY (check_vma=False disables shard_map's automatic
-    replication accounting), FFN-shard grads staying sharded."""
+    """The (loss, grads) program shared by both train steps: tokens
+    sharded ``(b, s/p)``, replicated-param grads psum'd EXPLICITLY
+    (check_vma=False disables shard_map's automatic replication
+    accounting), FFN-shard grads staying sharded.  The returned callable
+    resolves ``None`` hop knobs per call (``_resolve_cfg``) and
+    dispatches to a shard_map program cached on the RESOLVED config, so
+    later-banked tunes take effect."""
+    def grad_fn(params, tokens):
+        rcfg = _resolve_cfg(cfg, mesh, axis, tokens.shape)
+        return _grad_program(mesh, rcfg, axis)(params, tokens)
+
+    return grad_fn
+
+
+@functools.lru_cache(maxsize=32)
+def _grad_program(mesh, cfg: SPConfig, axis: str):
+    """The shard_map (loss, grads) program for a RESOLVED config (cfg is
+    value-hashable; one program per configuration)."""
     specs = param_specs(cfg, axis)
 
     def local(params, tokens_loc):
@@ -238,14 +284,15 @@ def make_grad_fn(mesh, cfg: SPConfig, axis: str = "p"):
 
 def make_optax_train_step(mesh, cfg: SPConfig, tx, axis: str = "p"):
     """Training with any optax optimizer: the (loss, grads) shard_map
-    program from ``make_grad_fn`` composed with ``tx.update`` under ONE
-    jit, in fp32 master precision (bf16 params/grads upcast for the
-    optimizer arithmetic — see ``transformer._optax_f32_step``) — GSPMD
-    lays the optimizer state out to match each param (Adam moments for
-    the tp-sharded FFN weights stay sharded, replicated params' moments
-    replicated).  Returns ``(step, init)``: ``state = init(params)``,
-    then ``step(params, opt_state, tokens) -> (params, opt_state,
-    loss)``.
+    program composed with ``tx.update`` under ONE jit, in fp32 master
+    precision (bf16 params/grads upcast for the optimizer arithmetic —
+    see ``transformer._optax_f32_step``) — GSPMD lays the optimizer
+    state out to match each param (Adam moments for the tp-sharded FFN
+    weights stay sharded, replicated params' moments replicated).  Hop
+    knobs left ``None`` resolve per call against the autotune registry,
+    outside the jitted-step cache (``_resolve_cfg``).  Returns ``(step,
+    init)``: ``state = init(params)``, then ``step(params, opt_state,
+    tokens) -> (params, opt_state, loss)``.
 
     Example::
 
@@ -255,15 +302,40 @@ def make_optax_train_step(mesh, cfg: SPConfig, tx, axis: str = "p"):
         params, state, loss = step(params, state, tokens)
     """
     from .transformer import _optax_f32_step
-    return _optax_f32_step(tx, make_grad_fn(mesh, cfg, axis))
+
+    built = {}
+
+    def step(params, opt_state, tokens):
+        rcfg = _resolve_cfg(cfg, mesh, axis, tokens.shape)
+        if rcfg not in built:
+            built[rcfg] = _optax_f32_step(
+                tx, lambda p, t: _grad_program(mesh, rcfg, axis)(p, t))[0]
+        return built[rcfg](params, opt_state, tokens)
+
+    def init(params):
+        # block-knob independent; fp32-master policy owned by transformer
+        from .transformer import _optax_f32_init
+        return _optax_f32_init(tx, params)
+
+    return step, init
 
 
 def make_train_step(mesh, cfg: SPConfig, axis: str = "p"):
-    """One jitted SGD train step over ``mesh``: ``make_grad_fn``'s
-    gradient program plus the SGD update under one jit (use
-    ``make_optax_train_step`` for a real optimizer).  Returns
+    """One jitted SGD train step over ``mesh``: the gradient program plus
+    the SGD update under one jit (use ``make_optax_train_step`` for a
+    real optimizer).  Hop knobs left ``None`` resolve per call against
+    the autotune registry, outside the jitted-step cache.  Returns
     ``step(params, tokens, lr) -> (params, loss)``."""
-    grad_fn = make_grad_fn(mesh, cfg, axis)
+    def step(params, tokens, lr):
+        rcfg = _resolve_cfg(cfg, mesh, axis, tokens.shape)
+        return _sgd_step(mesh, rcfg, axis)(params, tokens, lr)
+
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _sgd_step(mesh, cfg: SPConfig, axis: str):
+    grad_fn = _grad_program(mesh, cfg, axis)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def step(params, tokens, lr):

@@ -276,24 +276,32 @@ def _optax_f32_step(tx, grad_fn):
     returned ``init``)."""
     import optax
 
-    def as32(t):
-        return jax.tree_util.tree_map(
-            lambda x: x.astype(jnp.float32), t)
-
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens):
         loss, g = grad_fn(params, tokens)
-        p32 = as32(params)
-        updates, opt_state = tx.update(as32(g), opt_state, p32)
+        p32 = _as_f32(params)
+        updates, opt_state = tx.update(_as_f32(g), opt_state, p32)
         new32 = optax.apply_updates(p32, updates)
         new = jax.tree_util.tree_map(
             lambda n, p: n.astype(p.dtype), new32, params)
         return new, opt_state, loss
 
     def init(params):
-        return tx.init(as32(params))
+        return _optax_f32_init(tx, params)
 
     return step, init
+
+
+def _as_f32(t):
+    return jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), t)
+
+
+def _optax_f32_init(tx, params):
+    """Optimizer-state init from fp32 master params — the ONE owner of
+    the fp32-master policy's init half, shared by every step factory
+    (``_optax_f32_step`` here, ``sp_transformer.make_optax_train_step``)
+    so the upcast rule cannot silently diverge between them."""
+    return tx.init(_as_f32(params))
 
 
 def make_optax_train_step(cfg: Config, tx):

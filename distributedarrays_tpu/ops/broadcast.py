@@ -65,12 +65,17 @@ def _unwrap(x):
 
 def _spec_misfit(r, spec, mesh_sh):
     """Pre-check whether ``r`` can take ``mesh_sh`` without attempting the
-    device_put.  Returns None when the put would succeed, ``"silent"``
-    when it cannot but replication is the semantically-correct placement
-    anyway (a scalar, or misfits only on size-1 broadcast dims), or
-    ``("warn", dim)`` for a genuine degradation worth surfacing (rank
-    misfit of a non-trivial array: dim -1; a non-size-1 dim that does not
-    divide its mesh axes: that dim)."""
+    device_put.  Returns None when the put should be attempted,
+    ``"silent"`` when it cannot succeed but replication is the
+    semantically-correct placement anyway (a scalar, or misfits only on
+    size-1 broadcast dims), or ``("warn", dim)`` for a genuine
+    degradation worth surfacing (rank misfit of a non-trivial array:
+    dim -1 — the one case device_put genuinely rejects).
+
+    Non-dividing dims are NOT misfits: NamedSharding accepts uneven
+    shards, so those args go through `_put_global` like any other
+    (replicating them was a memory/bandwidth regression — ADVICE
+    round-4); the caller's except backstop covers real failures."""
     if r.ndim < len(spec):
         return "silent" if r.size == 1 else ("warn", -1)
     mesh_shape = mesh_sh.mesh.shape
@@ -82,9 +87,7 @@ def _spec_misfit(r, spec, mesh_sh):
         n = 1
         for a in axes:
             n *= mesh_shape[a]
-        if r.shape[i] % n != 0:
-            if r.shape[i] != 1:
-                return ("warn", i)
+        if r.shape[i] % n != 0 and r.shape[i] == 1:
             misfit = "silent"     # size-1 dim: pure numpy broadcast
     return misfit
 
@@ -134,14 +137,12 @@ def _align_devices(raw, sharding):
                 if misfit == "silent":
                     r = _replicate(r, mesh_sh)
                 else:
-                    _, dim = misfit
-                    why = ("its rank is below the spec's" if dim < 0 else
-                           f"dim {dim} does not divide its mesh axes")
                     r = _replicate(
                         r, mesh_sh, f"_align_devices:misfit:{r.shape}",
                         f"broadcast: arg with shape {r.shape} cannot take "
-                        f"the target sharding ({why}); replicating it "
-                        "over the target mesh instead")
+                        f"the target sharding (its rank is below the "
+                        "spec's); replicating it over the target mesh "
+                        "instead")
             else:
                 try:
                     from ..darray import _put_global

@@ -36,7 +36,7 @@ from jax import lax
 from ..parallel.collectives import pshift
 
 __all__ = ["allgather_matmul", "allgather_matmul_rhs",
-           "matmul_reducescatter", "tp_ffn"]
+           "matmul_reducescatter", "cannon_matmul", "tp_ffn"]
 
 
 def allgather_matmul(x, w, axis: str):
@@ -154,6 +154,67 @@ def matmul_reducescatter(x, w, axis: str):
         return acc + block((r - 1 - t) % p)
 
     return lax.fori_loop(1, p, body, acc)
+
+
+def cannon_matmul(a, b, row_axis: str, col_axis: str):
+    """2-D-grid GEMM as a Cannon-skewed double ring: the owned schedule
+    for ``C[i,j] = sum_t A[i,t] @ B[t,j]`` on a square ``(g, g)`` device
+    grid — the tile-grid ``mul!`` shape of the reference
+    (/root/reference/src/linalg.jl:189-253, where the caller ships A-row
+    and B-column tiles to each destination) and of BASELINE config 3
+    (16384² on a 2×2 block layout).
+
+    ``a``: this rank's ``(m_loc, k_loc)`` block of A on the grid
+    (``k_loc = k/g`` along grid columns); ``b``: the ``(k_loc, n_loc)``
+    block of B (k split along grid ROWS).  Returns the rank's
+    ``(m_loc, n_loc)`` block of ``A @ B`` — C never moves.
+
+    Schedule: one static pre-skew each (a single two-axis ``ppermute``:
+    A's row ``i`` rotates left by ``i``, B's column ``j`` rotates up by
+    ``j``), leaving rank ``(i, j)`` with the matching contraction panel
+    ``t = (i + j) % g``; then ``g`` local matmuls, each overlapped with
+    the single-hop rotation (A left along ``col_axis``, B up along
+    ``row_axis``) that delivers the next panel — XLA schedules the
+    ppermutes concurrently with the MXU work, so the wire time of both
+    rings hides behind the local GEMMs.  Square grids only: on ``(r, c)``
+    with ``r != c`` the panels misalign mid-ring (GSPMD owns that shape).
+    """
+    g = lax.axis_size(row_axis)
+    if lax.axis_size(col_axis) != g:
+        raise ValueError(
+            f"cannon_matmul needs a square grid; got "
+            f"{g}x{lax.axis_size(col_axis)}")
+    out_dtype = jnp.result_type(a.dtype, b.dtype)
+    if g == 1:
+        return (a @ b).astype(out_dtype)
+
+    # pre-skew: rank (i,j) ends holding A[i, (j+i)%g] and B[(i+j)%g, j]
+    # — one static permutation over the FLATTENED (row, col) axes each
+    # (a per-row shift amount is not expressible as a single-axis
+    # ppermute, whose perm must be uniform over the other axes)
+    axes = (row_axis, col_axis)
+    perm_a = [(i * g + j, i * g + (j - i) % g)
+              for i in range(g) for j in range(g)]
+    perm_b = [(i * g + j, ((i - j) % g) * g + j)
+              for i in range(g) for j in range(g)]
+    a = lax.ppermute(a, axes, perm_a)
+    b = lax.ppermute(b, axes, perm_b)
+
+    def step(a, b):
+        return (a @ b).astype(out_dtype)
+
+    def body(t, carry):
+        a, b, acc = carry
+        na = pshift(a, col_axis, -1)        # fetch grid-col j+1's panel
+        nb = pshift(b, row_axis, -1)        # fetch grid-row i+1's panel
+        return na, nb, acc + step(a, b)
+
+    # step 0's product seeds the accumulator (also keeps the carry
+    # varying over the mesh axes for shard_map's type system)
+    a, b, acc = lax.fori_loop(
+        1, g - 1, body,
+        (pshift(a, col_axis, -1), pshift(b, row_axis, -1), step(a, b)))
+    return acc + step(a, b)
 
 
 def tp_ffn(x, w1, w2, axis: str, act=None):

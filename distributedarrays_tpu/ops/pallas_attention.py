@@ -609,7 +609,7 @@ def tuned_flash_config(S, H, D, dtype, causal: bool,
     from ..utils import autotune
     vals = autotune.valid_ints(
         autotune.get("flash_attention",
-                     autotune.key_for(S, H, D, dtype, bool(causal))),
+                     autotune.device_key_for(S, H, D, dtype, bool(causal))),
         (2, 3))
     tq, tk = (vals[0], vals[1]) if vals else (default, default)
     tf = vals[2] if vals and len(vals) == 3 else 1

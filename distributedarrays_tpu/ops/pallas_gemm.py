@@ -56,7 +56,7 @@ def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
     if block is None:
         from ..utils import autotune
         vals = autotune.valid_ints(
-            autotune.get(kernel, autotune.key_for(m, n, k, *dtype_key)),
+            autotune.get(kernel, autotune.device_key_for(m, n, k, *dtype_key)),
             (3,))
         if vals is not None:
             tm, tn, tk = vals

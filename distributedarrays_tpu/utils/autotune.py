@@ -26,7 +26,7 @@ import threading
 from typing import Any, Callable, Iterable, Mapping
 
 __all__ = ["get", "record", "sweep", "save", "load", "clear", "key_for",
-           "valid_ints",
+           "device_key_for", "valid_ints",
            "default_cache_path", "save_default"]
 
 _LOCK = threading.RLock()
@@ -37,6 +37,18 @@ _LOADED_ENV = False
 def key_for(*parts) -> str:
     """Canonical string key from shape/dtype/flag parts."""
     return "|".join(str(p) for p in parts)
+
+
+def device_key_for(*parts) -> str:
+    """``key_for`` with the default device's platform and kind appended.
+    Every kernel-tuning registry (flash blocks, ring hop blocks, GEMM
+    tiles, impl choices) keys through this: a winner measured on one
+    platform (CPU/interpret validation run, v4, v5e...) must never drive
+    dispatch on another, even through the shared persisted cache
+    (ADVICE round-4)."""
+    import jax
+    dev = jax.devices()[0]
+    return key_for(*parts, dev.platform, dev.device_kind)
 
 
 def valid_ints(entry, lengths: tuple[int, ...]):

@@ -1,18 +1,22 @@
-"""Child program for the 2-process jax.distributed smoke test.
+"""Child program for the multi-process jax.distributed cluster tests.
 
 Run as: python tests/_multihost_child.py <coordinator_port> <process_id> \
-            [smoke|full]
+            [smoke|full] [num_processes]
 
-Each process owns 4 virtual CPU devices; together they form one 8-device
-global mesh — the moral equivalent of the reference's multi-process
-addprocs harness (/root/reference/test/runtests.jl:10-13), but with two
-real OS processes joined through ``jax.distributed`` (the DCN path).
+Each process owns 4 virtual CPU devices; ``num_processes`` of them
+(default 2) form one ``4*num_processes``-device global mesh — the moral
+equivalent of the reference's multi-process addprocs harness
+(/root/reference/test/runtests.jl:10-15, which REFUSES to run with fewer
+than 3 workers: ``@assert nworkers() >= 3``).  p=2 is degenerate for ring
+topologies (left neighbor == right neighbor) and for all_to_all ordering,
+so the slow leg drives this matrix at 3 AND 4 processes (VERDICT round-4
+item 4); the default loop keeps a <60 s 2-process smoke.
 
-``smoke`` (the default test loop's <60 s guard) runs cluster formation +
-the core DArray construction/psum/sum/gather; ``full`` (slow-marked / CI)
-adds the complete cross-process op matrix: elementwise, reductions, GEMM,
-uneven layouts, scan, FFT, dsort, a compiled run_spmd+pshift program, a
-checkpoint save/restore round-trip, and ring attention.
+``smoke`` runs cluster formation + the core DArray
+construction/psum/sum/gather; ``full`` adds the complete cross-process op
+matrix: elementwise, reductions, GEMM, uneven layouts, scan, FFT, dsort,
+a compiled run_spmd+pshift program, a checkpoint save/restore round-trip,
+and ring attention.
 """
 
 import os
@@ -20,6 +24,7 @@ import sys
 
 port, proc_id = sys.argv[1], int(sys.argv[2])
 stage = sys.argv[3] if len(sys.argv) > 3 else "full"
+nprocs = int(sys.argv[4]) if len(sys.argv) > 4 else 2
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -35,32 +40,33 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 from distributedarrays_tpu.parallel import multihost  # noqa: E402
 
 multihost.initialize(coordinator_address=f"localhost:{port}",
-                     num_processes=2, process_id=proc_id)
+                     num_processes=nprocs, process_id=proc_id)
 
 info = multihost.process_info()
-assert info["process_count"] == 2, info
+assert info["process_count"] == nprocs, info
 assert info["local_devices"] == 4, info
-assert info["global_devices"] == 8, info
+N = 4 * nprocs
+assert info["global_devices"] == N, info
 
-mesh = multihost.global_mesh((8,), ("x",))
+mesh = multihost.global_mesh((N,), ("x",))
 
-# --- one psum across both processes (compiled collective over "DCN") ------
+# --- one psum across all processes (compiled collective over "DCN") -------
 sh = NamedSharding(mesh, P("x"))
-host = np.arange(8.0, dtype=np.float32)
-garr = jax.make_array_from_callback((8,), sh, lambda idx: host[idx])
+host = np.arange(float(N), dtype=np.float32)
+garr = jax.make_array_from_callback((N,), sh, lambda idx: host[idx])
 total = jax.jit(jax.shard_map(lambda x: jax.lax.psum(jnp.sum(x), "x"),
                               mesh=mesh, in_specs=P("x"), out_specs=P()))(garr)
-assert float(total.addressable_data(0)) == 28.0, total
+assert float(total.addressable_data(0)) == N * (N - 1) / 2, total
 
 # --- one DArray constructed across processes ------------------------------
 import distributedarrays_tpu as dat  # noqa: E402
 
-A = np.arange(16.0, dtype=np.float32)
-d = dat.distribute(A)  # default layout spans all 8 global devices
-assert not d.garray.is_fully_addressable, "DArray should span both processes"
+A = np.arange(2.0 * N, dtype=np.float32)
+d = dat.distribute(A)  # default layout spans all global devices
+assert not d.garray.is_fully_addressable, "DArray should span processes"
 assert len(d.garray.addressable_shards) == 4  # this process's local shards
 s = dat.dsum(d)
-assert float(s.addressable_data(0)) == 120.0, s
+assert float(s.addressable_data(0)) == (2 * N) * (2 * N - 1) / 2, s
 
 # localpart of a rank owned by this process comes off a local shard
 local_pids = [pid for pid, _ in multihost.host_local_slice(d)]
@@ -85,7 +91,7 @@ if stage == "smoke":
 
 # elementwise (djit broadcast fusion) over the global mesh
 X = np.linspace(-1.0, 1.0, 64, dtype=np.float32).reshape(16, 4)
-dx = dat.distribute(X)                      # even 2-D layout spans processes
+dx = dat.distribute(X)                      # default layout spans processes
 assert not dx.garray.is_fully_addressable
 ew = dat.djit(lambda a: jnp.sin(a) * 2 + 1)(dx)
 np.testing.assert_allclose(multihost.gather_global(ew), np.sin(X) * 2 + 1,
@@ -98,20 +104,23 @@ np.testing.assert_allclose(multihost.gather_global(col),
 tot = float(dat.dmapreduce(jnp.square, "sum", dx).addressable_data(0))
 np.testing.assert_allclose(tot, (X ** 2).sum(), rtol=1e-5)
 
-# GEMM over a 2x4 process-spanning grid (XLA SUMMA over the DCN mesh)
-Am = np.arange(32.0 * 16, dtype=np.float32).reshape(32, 16) / 100
-Bm = np.arange(16.0 * 8, dtype=np.float32).reshape(16, 8) / 100
-da = dat.distribute(Am, procs=range(8), dist=(2, 4))
-db = dat.distribute(Bm, procs=range(8), dist=(4, 2))
+# GEMM over a 2x(N/2) process-spanning grid (XLA SUMMA over the DCN mesh)
+Am = np.arange(32.0 * 4 * N, dtype=np.float32).reshape(32, 4 * N) / 100
+Bm = np.arange(4.0 * N * 8, dtype=np.float32).reshape(4 * N, 8) / 100
+da = dat.distribute(Am, procs=range(N), dist=(2, N // 2))
+db = dat.distribute(Bm, procs=range(N), dist=(N // 2, 2))
 dc = da @ db
 np.testing.assert_allclose(multihost.gather_global(dc), Am @ Bm,
-                           rtol=1e-4, atol=1e-5)
+                           rtol=1e-4, atol=1e-4)
 
 # uneven (blocked-padded) ctor across processes: the _place_chunked
-# non-addressable branch
+# non-addressable branch.  50 rows over N/2 row-ranks is uneven for
+# every N here (leading-remainder cuts, reference chunk_sizes)
 U = np.arange(50.0 * 8, dtype=np.float32).reshape(50, 8)
-du = dat.distribute(U, procs=range(8), dist=(4, 2))
-assert [int(c) for c in np.diff(du.cuts[0])] == [13, 13, 12, 12]
+du = dat.distribute(U, procs=range(N), dist=(N // 2, 2))
+q, r = divmod(50, N // 2)
+assert [int(c) for c in np.diff(du.cuts[0])] == [q + 1] * r + \
+    [q] * (N // 2 - r)
 np.testing.assert_allclose(multihost.gather_global(du), U)
 u2 = du + du
 np.testing.assert_allclose(multihost.gather_global(u2), U * 2)
@@ -127,12 +136,14 @@ np.testing.assert_allclose(multihost.gather_global(cs),
                            np.cumsum(S1, axis=0), rtol=1e-5, atol=1e-5)
 # round-4: UNEVEN scan (padded compiled path) across processes
 su = np.arange(50.0, dtype=np.float32) / 9
-dsu = dat.distribute(su)                    # cuts [7,7,6,6,6,6,6,6]
+dsu = dat.distribute(su)                    # uneven cuts over N devices
 csu = dat.dcumsum(dsu)
 np.testing.assert_allclose(multihost.gather_global(csu),
                            np.cumsum(su), rtol=1e-5, atol=1e-5)
-F1 = np.sin(np.arange(32.0 * 16, dtype=np.float32)).reshape(32, 16)
-dfm = dat.distribute(F1, procs=range(8), dist=(8, 1))
+# columns = N so the all_to_all repartition dim divides the shard count
+# (keeps the COMPILED matrix path exercised at every process count)
+F1 = np.sin(np.arange(4.0 * N * N, dtype=np.float32)).reshape(4 * N, N)
+dfm = dat.distribute(F1, procs=range(N), dist=(N, 1))
 ff = dat.dfft(dfm, axis=0)                  # all_to_all across processes
 np.testing.assert_allclose(multihost.gather_global(ff),
                            np.fft.fft(F1, axis=0), rtol=1e-3, atol=1e-3)
@@ -143,22 +154,24 @@ for a in (ds, cs, dsu, csu, dfm, ff):
 
 # dsort: the PSRS shard_map program over the process-spanning mesh
 rngs = np.random.default_rng(7)
-sv = rngs.standard_normal(64).astype(np.float32)
-dsv = dat.distribute(sv)                    # spans both processes
+sv = rngs.standard_normal(8 * N).astype(np.float32)
+dsv = dat.distribute(sv)                    # spans all processes
 assert not dsv.garray.is_fully_addressable
 srt = dat.dsort(dsv)
 np.testing.assert_allclose(multihost.gather_global(srt), np.sort(sv),
                            rtol=1e-6, atol=1e-6)
 
-# compiled SPMD collective program: run_spmd + pshift ring hop over DCN
+# compiled SPMD collective program: run_spmd + pshift ring hop over DCN.
+# At p>=3 the +1 shift is direction-sensitive (left != right neighbor —
+# the asymmetry a 2-process ring cannot catch, runtests.jl:14-15)
 from distributedarrays_tpu.parallel import collectives as C  # noqa: E402
 from jax.sharding import PartitionSpec as P2  # noqa: E402
 
 ring = C.run_spmd(lambda x: C.pshift(x, "x", 1), mesh,
                   in_specs=P2("x"), out_specs=P2("x"))
-rin = np.arange(8.0, dtype=np.float32)
+rin = np.arange(float(N), dtype=np.float32)
 rarr = jax.make_array_from_callback(
-    (8,), NamedSharding(mesh, P2("x")), lambda idx: rin[idx])
+    (N,), NamedSharding(mesh, P2("x")), lambda idx: rin[idx])
 rout = multihost.gather_global(ring(rarr))
 np.testing.assert_array_equal(rout, np.roll(rin, 1))  # i receives i-1's
 
@@ -179,13 +192,16 @@ with tempfile.TemporaryDirectory() as td:
                                rtol=1e-6)
     assert back["w"].cuts == dck.cuts
 
-# ring attention across processes: the seq dim sharded over the 8-device
-# global mesh, softmax statistics riding the DCN+ICI ring
+# ring attention across processes: the seq dim sharded over the global
+# mesh, softmax statistics riding the DCN+ICI ring (at p>=3 every rank's
+# K/V block transits ranks it is NOT adjacent to — hop-order bugs that a
+# 2-rank ring folds away surface here)
 from distributedarrays_tpu.models.ring_attention import (  # noqa: E402
     ring_attention)
 
-S, H, Dh = 32, 2, 8
-qkv = [dat.distribute(rngs.standard_normal((S, H, Dh)).astype(np.float32))
+S, H, Dh = 4 * N, 2, 8
+qkv = [dat.distribute(rngs.standard_normal((S, H, Dh)).astype(np.float32),
+                      procs=range(N), dist=(N, 1, 1))
        for _ in range(3)]
 assert not qkv[0].garray.is_fully_addressable
 att = ring_attention(*qkv)

@@ -87,3 +87,12 @@ def test_mesh_cache_and_sharding():
 def test_mesh_for_too_few_ranks():
     with pytest.raises(ValueError):
         L.mesh_for(range(4), (4, 2))
+
+
+def test_mesh_for_rank_ids_beyond_devices():
+    # rank ids past the visible device count must raise the same
+    # ValueError family as the count check, not a raw numpy IndexError
+    with pytest.raises(ValueError, match="out of range"):
+        L.mesh_for(range(64), (8, 8))
+    with pytest.raises(ValueError, match="out of range"):
+        L.mesh_for([0, -3], (2,))

@@ -234,6 +234,80 @@ def test_matmul_ring_allgather_dispatch(rng, monkeypatch):
     dat.d_closeall()
 
 
+def test_matmul_summa_dispatch(rng, monkeypatch):
+    # the square 2-D-grid shape (BASELINE config 3): A and B block-
+    # distributed on the SAME (g,g) grid -> C on that grid, run as ONE
+    # Cannon double-ring shard_map program when the registry promotes it
+    from distributedarrays_tpu.utils import autotune
+    autotune.clear()
+    A = rng.standard_normal((16, 24)).astype(np.float32)
+    B = rng.standard_normal((24, 8)).astype(np.float32)
+    da = dat.distribute(A, procs=range(4), dist=(2, 2))
+    db = dat.distribute(B, procs=range(4), dist=(2, 2))
+    called = []
+    orig = la._summa_gemm
+    monkeypatch.setattr(la, "_summa_gemm",
+                        lambda *a: called.append(1) or orig(*a))
+    # default (no banked entry): GSPMD path
+    C0 = da @ db
+    assert not called
+    assert np.allclose(np.asarray(C0), A @ B, rtol=1e-4, atol=1e-4)
+    # promoted: Cannon path, both out-of-place and mul_into
+    autotune.record("matmul_impl_dist",
+                    la._impl_key(16, 8, 24, "2x2", da.dtype, db.dtype),
+                    "summa")
+    C1 = da @ db
+    assert called, "banked summa win must route through the Cannon ring"
+    assert np.allclose(np.asarray(C1), A @ B, rtol=1e-4, atol=1e-4)
+    assert list(C1.pids.shape) == [2, 2] and C1.cuts[0] == da.cuts[0]
+    called.clear()
+    C2 = dat.dzeros((16, 8), procs=range(4), dist=(2, 2))
+    la.mul_into(C2, da, db)
+    assert called
+    assert np.allclose(np.asarray(C2), A @ B, rtol=1e-4, atol=1e-4)
+    # alpha/beta mode stays off the ring
+    called.clear()
+    C3 = dat.dzeros((16, 8), procs=range(4), dist=(2, 2))
+    la.mul_into(C3, da, db, alpha=2.0)
+    assert not called
+    assert np.allclose(np.asarray(C3), 2 * (A @ B), rtol=1e-4, atol=1e-4)
+    # a rectangular grid is NOT eligible even with a banked entry
+    da2 = dat.distribute(A, procs=range(8), dist=(2, 4))
+    db2 = dat.distribute(B, procs=range(8), dist=(4, 2))
+    autotune.record("matmul_impl_dist",
+                    la._impl_key(16, 8, 24, "2x4", da2.dtype, db2.dtype),
+                    "summa")
+    called.clear()
+    C4 = da2 @ db2
+    assert not called
+    assert np.allclose(np.asarray(C4), A @ B, rtol=1e-4, atol=1e-4)
+    autotune.clear()
+    dat.d_closeall()
+
+
+def test_tune_matmul_impl_summa_banks_winner():
+    from distributedarrays_tpu.utils import autotune
+    autotune.clear()
+    times = {"jnp": 1.0, "summa": 0.5}
+    seen = []
+
+    def timer(op, a, b):
+        assert a.shape == (16, 24) and b.shape == (24, 8)
+        name = "jnp" if not seen else "summa"
+        seen.append(name)
+        return times[name]
+
+    winner, results = la.tune_matmul_impl_summa(
+        16, 8, 24, g=2, timer=timer, persist=False)
+    assert winner == "summa" and results == times
+    f32 = jnp.float32(0).dtype
+    assert autotune.get("matmul_impl_dist",
+                        la._impl_key(16, 8, 24, "2x2", f32, f32)) == "summa"
+    with pytest.raises(ValueError, match="divisible"):
+        la.tune_matmul_impl_summa(15, 8, 24, g=2, timer=timer)
+    autotune.clear()
+
+
 def test_tune_matmul_impl_banks_winner():
     from distributedarrays_tpu.utils import autotune
     autotune.clear()

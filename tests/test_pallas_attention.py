@@ -95,7 +95,7 @@ def test_flash_autotune_three_tuple_entry(qkv):
     from distributedarrays_tpu.utils import autotune
     q, k, v = qkv
     want = reference_attention(q, k, v)
-    key = autotune.key_for(128, 2, 16, q.dtype, False)
+    key = autotune.device_key_for(128, 2, 16, q.dtype, False)
     autotune.clear()
     autotune.record("flash_attention", key, (32, 32, 2))
     got = np.asarray(flash_attention(q, k, v))

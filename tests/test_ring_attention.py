@@ -370,10 +370,10 @@ def test_ring_flash_blocks_from_registry(rng):
                                causal=True)
     autotune.clear()
     autotune.record("ring_flash",
-                    autotune.key_for(B, H, D, q.dtype, True), (32, 64))
+                    autotune.device_key_for(B, H, D, q.dtype, True), (32, 64))
     np.testing.assert_allclose(run(), want, rtol=2e-3, atol=2e-3)
     autotune.record("ring_flash",
-                    autotune.key_for(B, H, D, q.dtype, True), "bogus")
+                    autotune.device_key_for(B, H, D, q.dtype, True), "bogus")
     np.testing.assert_allclose(run(), want, rtol=2e-3, atol=2e-3)
     autotune.clear()
 
@@ -402,7 +402,7 @@ def test_ring_flash_head_fold_matches(rng):
         return shm(q, q, q)
 
     autotune.clear()
-    key = autotune.key_for(B, H, D, q.dtype, True)
+    key = autotune.device_key_for(B, H, D, q.dtype, True)
     autotune.record("ring_flash", key, (32, 64))
     base = np.asarray(run())
     autotune.record("ring_flash", key, (32, 64, 2))
@@ -449,7 +449,7 @@ def test_zigzag_flash_head_fold_matches(rng):
         return shm(a, q, q)
 
     autotune.clear()
-    key = autotune.key_for(B, H, D, q.dtype, True)
+    key = autotune.device_key_for(B, H, D, q.dtype, True)
     autotune.record("ring_flash", key, (16, 16))
     base = np.asarray(run(q))
     gbase = jax.grad(lambda a: jnp.sum(run(a) ** 2))(q)

@@ -175,6 +175,45 @@ def test_sp_transformer_trains(sp_setup):
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_sp_transformer_picks_up_later_banked_tune(sp_setup, monkeypatch):
+    # the train-step factories must resolve None hop knobs OUTSIDE their
+    # cached jits (ADVICE round-4): a tune banked AFTER the first step
+    # call must change the dispatched program, not be pinned at first
+    # trace.  Resolution is spied at _resolve_cfg's registry consumer.
+    from distributedarrays_tpu.utils import autotune
+    from distributedarrays_tpu.models import ring_attention as RA
+    SPT, C, p, mesh, cfg, params, tokens = sp_setup
+    autotune.clear()
+    tcfg = SPT.SPConfig(vocab=64, dim=32, heads=4, layers=2, max_seq=32,
+                        dtype=jnp.float32, interpret=True)  # knobs None
+    step = SPT.make_train_step(mesh, tcfg)
+    prm = SPT.init_params(jax.random.key(3), tcfg)
+    seen = []
+    real = RA.tuned_hop_blocks_for
+
+    def spy(shape, dtype, causal, bq, bk):
+        out = real(shape, dtype, causal, bq, bk)
+        seen.append(out)
+        return out
+
+    monkeypatch.setattr(RA, "tuned_hop_blocks_for", spy)
+    prm, l0 = step(prm, tokens, jnp.float32(0.1))
+    assert seen and seen[-1][:2] == (512, 512)   # default, nothing banked
+    # bank a tune for the per-rank hop shape this model sees:
+    # (s_loc, b*heads, head_dim) under causal=True
+    B, S = tokens.shape
+    key = autotune.device_key_for(S // p, B * tcfg.heads,
+                                  tcfg.dim // tcfg.heads,
+                                  jnp.dtype(tcfg.dtype), True)
+    autotune.record("ring_flash", key, (4, 4))
+    seen.clear()
+    prm, l1 = step(prm, tokens, jnp.float32(0.1))
+    assert seen and seen[-1][:2] == (4, 4), \
+        "a tune banked after step 1 must reach the next step's dispatch"
+    assert np.isfinite(float(l1))
+    autotune.clear()
+
+
 def test_sp_transformer_max_seq_guard(sp_setup):
     # position reads past the table would CLAMP silently; must raise
     SPT, C, p, mesh, cfg, params, tokens = sp_setup

@@ -385,7 +385,7 @@ def test_flash_attention_consults_autotune(rng, tmp_path, monkeypatch):
     S, H, D = 256, 2, 32
     q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
     base = np.asarray(flash_attention(q, q, q, block_q=128, block_k=128))
-    key = autotune.key_for(S, H, D, q.dtype, False)
+    key = autotune.device_key_for(S, H, D, q.dtype, False)
     autotune.record("flash_attention", key, (64, 64))
     # spy: the kernel must ask the registry with exactly this key
     calls = []
@@ -414,7 +414,7 @@ def test_pallas_matmul_malformed_tuned_entry_degrades(rng, tmp_path,
     import jax.numpy as jnp
     a = jnp.asarray(rng.standard_normal((256, 256)), jnp.float32)
     want = np.asarray(a) @ np.asarray(a)
-    key = autotune.key_for(256, 256, 256, a.dtype, a.dtype)
+    key = autotune.device_key_for(256, 256, 256, a.dtype, a.dtype)
     for bad in ([256, 256], [0, 0, 0], [7, 13, 99], "junk"):
         autotune.record("pallas_matmul", key, bad)
         got = np.asarray(pallas_matmul(a, a))
@@ -436,7 +436,7 @@ def test_pallas_matmul_int8_malformed_tuned_entry_degrades(
     qa, sa = pg.quantize_rows(a, 1)
     qb, sb = pg.quantize_rows(a, 0)
     want = np.asarray(pg.pallas_matmul_int8(qa, qb, sa, sb, interpret=True))
-    key = autotune.key_for(256, 256, 256, "int8")
+    key = autotune.device_key_for(256, 256, 256, "int8")
     # force the non-interpret resolution path to prove alignment filtering
     # (the kernel itself still runs in interpret mode on CPU)
     for bad in ([8, 128, 128], [32, 64, 128], [32, 128, 64], "junk"):
