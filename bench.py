@@ -194,15 +194,25 @@ _START = time.monotonic()
 _GLOBAL_BUDGET_S = float(os.environ.get("DAT_BENCH_BUDGET_S", "3300"))
 
 
+_ONLY = {s for s in os.environ.get("DAT_BENCH_ONLY", "").split(",") if s}
+
+
 def _guarded(details, label, fn, timeout_s=420.0):
     """Run one optional bench config on a daemon thread with a timeout and
     a global deadline: a wedged tunnel (observed: remote_compile dying
     mid-read, then every subsequent dispatch hanging) must cost at most
     one config's budget, and never the already-banked numbers or the
-    headline.  ``fn`` returns a dict merged into ``details``."""
+    headline.  ``fn`` returns a dict merged into ``details``.
+    ``DAT_BENCH_ONLY=label1,label2`` restricts the optional configs to the
+    named ones (targeted harness validation; a short hardware window can
+    aim straight at the config it needs)."""
     def _remaining():
         return _GLOBAL_BUDGET_S - (time.monotonic() - _START)
 
+    if _ONLY and label not in _ONLY:
+        details[f"{label}_error"] = "skipped (DAT_BENCH_ONLY)"
+        _save(details)
+        return
     if _remaining() < 60:
         details[f"{label}_error"] = "skipped (global bench deadline)"
         _save(details)
@@ -1085,10 +1095,13 @@ def main():
 
     def cfg_decode():
         from distributedarrays_tpu.models import transformer as T
+        # DAT_BENCH_DECODE_STEPS: harness-validation override (the full
+        # 2k-step scan is minutes-slow on host CPU, seconds on a chip)
+        total = max(int(os.environ.get("DAT_BENCH_DECODE_STEPS", 2032)), 32)
         cfg = T.Config(vocab=8192, dim=1024, heads=16, layers=8,
-                       ffn_mult=4, max_seq=2048, dtype=jnp.bfloat16)
+                       ffn_mult=4, max_seq=total, dtype=jnp.bfloat16)
         params = T.init_params(jax.random.key(2), cfg)
-        Bd, S0, NEW = 8, 16, 2032 - 16
+        Bd, S0, NEW = 8, 16, total - 16
         prompt = jax.random.randint(jax.random.key(3), (Bd, S0), 0,
                                     cfg.vocab, dtype=jnp.int32)
 

@@ -36,7 +36,20 @@ from jax import lax
 from ..parallel.collectives import pshift
 
 __all__ = ["allgather_matmul", "allgather_matmul_rhs",
-           "matmul_reducescatter", "cannon_matmul", "tp_ffn"]
+           "matmul_reducescatter", "cannon_matmul", "cannon_matmul_int8",
+           "tp_ffn"]
+
+
+def _cannon_skew_perms(g: int):
+    """The two static pre-skew permutations over the FLATTENED (row, col)
+    axes: A's row ``i`` rotates left by ``i``; B's column ``j`` rotates up
+    by ``j`` — leaving rank ``(i, j)`` with contraction panel
+    ``t = (i + j) % g`` of each operand."""
+    perm_a = [(i * g + j, i * g + (j - i) % g)
+              for i in range(g) for j in range(g)]
+    perm_b = [(i * g + j, ((i - j) % g) * g + j)
+              for i in range(g) for j in range(g)]
+    return perm_a, perm_b
 
 
 def allgather_matmul(x, w, axis: str):
@@ -193,10 +206,7 @@ def cannon_matmul(a, b, row_axis: str, col_axis: str):
     # (a per-row shift amount is not expressible as a single-axis
     # ppermute, whose perm must be uniform over the other axes)
     axes = (row_axis, col_axis)
-    perm_a = [(i * g + j, i * g + (j - i) % g)
-              for i in range(g) for j in range(g)]
-    perm_b = [(i * g + j, ((i - j) % g) * g + j)
-              for i in range(g) for j in range(g)]
+    perm_a, perm_b = _cannon_skew_perms(g)
     a = lax.ppermute(a, axes, perm_a)
     b = lax.ppermute(b, axes, perm_b)
 
@@ -215,6 +225,58 @@ def cannon_matmul(a, b, row_axis: str, col_axis: str):
         1, g - 1, body,
         (pshift(a, col_axis, -1), pshift(b, row_axis, -1), step(a, b)))
     return acc + step(a, b)
+
+
+def cannon_matmul_int8(a, b, row_axis: str, col_axis: str,
+                       out_dtype=jnp.float32, interpret: bool | None = None):
+    """``cannon_matmul`` with int8 panels: each rank quantizes its blocks
+    ONCE (per-row A / per-column B symmetric int8, the
+    ``quantized_matmul`` scheme), the int8 panels + their scales ride the
+    double ring (4x less ICI traffic than the f32 panels), and every hop
+    runs the Pallas int8 kernel with exact int32 accumulation and
+    per-panel fused dequant, summed in f32.
+
+    Quantization error matches the single-device ``quantized_matmul``
+    family (each contraction panel dequantizes exactly; the sum of
+    per-panel dequantized products is the standard blocked quantized
+    GEMM).  Square grids only, like ``cannon_matmul``.  The DArray entry
+    is ``linalg.dmatmul_int8`` with both operands on one (g, g) grid.
+    """
+    from .pallas_gemm import pallas_matmul_int8, quantize_rows, \
+        quantized_matmul
+    g = lax.axis_size(row_axis)
+    if lax.axis_size(col_axis) != g:
+        raise ValueError(
+            f"cannon_matmul_int8 needs a square grid; got "
+            f"{g}x{lax.axis_size(col_axis)}")
+    if g == 1:
+        return quantized_matmul(a, b, out_dtype=out_dtype,
+                                interpret=interpret)
+    qa, sa = quantize_rows(a, 1)            # per-row scales of this panel
+    qb, sb = quantize_rows(b, 0)            # per-column scales
+    axes = (row_axis, col_axis)
+    perm_a, perm_b = _cannon_skew_perms(g)
+    qa, sa = (lax.ppermute(t, axes, perm_a) for t in (qa, sa))
+    qb, sb = (lax.ppermute(t, axes, perm_b) for t in (qb, sb))
+
+    def step(qa_, qb_, sa_, sb_):
+        return pallas_matmul_int8(qa_, qb_, sa_, sb_,
+                                  out_dtype=jnp.float32,
+                                  interpret=interpret)
+
+    def hop(ts):
+        qa_, sa_, qb_, sb_ = ts
+        return (pshift(qa_, col_axis, -1), pshift(sa_, col_axis, -1),
+                pshift(qb_, row_axis, -1), pshift(sb_, row_axis, -1))
+
+    def body(t, carry):
+        qa_, sa_, qb_, sb_, acc = carry
+        nxt = hop((qa_, sa_, qb_, sb_))
+        return (*nxt, acc + step(qa_, qb_, sa_, sb_))
+
+    qa, sa, qb, sb, acc = lax.fori_loop(
+        1, g - 1, body, (*hop((qa, sa, qb, sb)), step(qa, qb, sa, sb)))
+    return (acc + step(qa, qb, sa, sb)).astype(out_dtype)
 
 
 def tp_ffn(x, w1, w2, axis: str, act=None):

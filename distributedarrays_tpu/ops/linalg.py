@@ -295,6 +295,30 @@ def _dist_impl_choice(m, n, k, p, a_dtype, b_dtype):
         "matmul_impl_dist", _impl_key(m, n, k, p, a_dtype, b_dtype)) or "jnp"
 
 
+def _square_grid_ok(A: DArray, B):
+    """Shared (g,g)×(g,g) eligibility core for the Cannon schedules
+    (``matmul``'s summa dispatch AND ``dmatmul_int8``'s grid branch —
+    one owner, so the rules cannot diverge): both operands DArrays on
+    the SAME square rank grid, unpadded (⇒ even chunks on every axis),
+    fully addressable (eager device_put cannot move bytes between
+    hosts — same guard as ``_ring_ag_eligible``; ADVICE round-4).
+    Returns ``g`` (>= 2) or ``None``."""
+    if not isinstance(B, DArray):
+        return None
+    if A.pids.ndim != 2 or B.pids.ndim != 2:
+        return None
+    g = A.pids.shape[0]
+    if g < 2 or A.pids.shape != (g, g) or B.pids.shape != (g, g):
+        return None
+    if [int(q) for q in B.pids.flat] != [int(q) for q in A.pids.flat]:
+        return None
+    if A._padded or B._padded:
+        return None
+    if not (A.garray.is_fully_addressable and B.garray.is_fully_addressable):
+        return None
+    return g
+
+
 def _summa_eligible(A: DArray, B, procs, dist):
     """The square 2-D-grid shape the Cannon schedule serves: A and B on
     the SAME ``(g, g)`` rank grid, result on that grid too — the
@@ -302,28 +326,17 @@ def _summa_eligible(A: DArray, B, procs, dist):
     config 3 (16384² on 2×2).  Plain GSPMD SUMMAs this itself; the
     owned schedule pipelines both panel rings behind the local GEMMs and
     must earn its place by measurement (``_summa_impl_choice``)."""
-    if not isinstance(B, DArray):
-        return False
-    if A.pids.ndim != 2 or B.pids.ndim != 2:
-        return False
-    g = A.pids.shape[0]
-    if g < 2 or A.pids.shape != (g, g) or B.pids.shape != (g, g):
+    g = _square_grid_ok(A, B)
+    if g is None:
         return False
     aprocs = [int(q) for q in A.pids.flat]
-    if [int(q) for q in B.pids.flat] != aprocs:
-        return False
     if list(dist) != [g, g] or [int(q) for q in procs[:g * g]] != aprocs:
-        return False
-    # eager device_put cannot move bytes between hosts (same guard as
-    # _ring_ag_eligible; ADVICE round-4)
-    if not (A.garray.is_fully_addressable and B.garray.is_fully_addressable):
         return False
     # even chunking everywhere the double ring assumes it: m and n by g,
     # k by g along BOTH grid axes (A splits k over columns, B over rows)
     m, k = A.dims
     n = B.dims[1]
-    return (m % g == 0 and n % g == 0 and k % g == 0
-            and not (A._padded or B._padded))
+    return m % g == 0 and n % g == 0 and k % g == 0
 
 
 def _summa_impl_choice(m, n, k, g, a_dtype, b_dtype):
@@ -400,6 +413,24 @@ def _tune_impls(kernel, key, candidates, a, b, timer, persist):
 
 
 @functools.lru_cache(maxsize=None)
+def _int8_cannon_jit(procs, g, out_dtype_str):
+    """One shard_map program: Cannon double ring with int8 panels +
+    per-panel scales riding the hops (``cannon_matmul_int8``)."""
+    from .collective_matmul import cannon_matmul_int8
+    mesh = L.mesh_for(procs, (g, g))
+    ax_r, ax_c = mesh.axis_names
+
+    def prog(a, b):
+        return cannon_matmul_int8(a, b, ax_r, ax_c,
+                                  out_dtype=out_dtype_str)
+
+    shm = jax.shard_map(prog, mesh=mesh,
+                        in_specs=(P(ax_r, ax_c), P(ax_r, ax_c)),
+                        out_specs=P(ax_r, ax_c), check_vma=False)
+    return mesh, (ax_r, ax_c), jax.jit(shm)
+
+
+@functools.lru_cache(maxsize=None)
 def _int8_shm_jit(procs, p, out_dtype_str):
     """One shard_map program: per-rank dynamic-quantized int8 GEMM of the
     resident row block against the replicated right operand."""
@@ -426,10 +457,13 @@ def dmatmul_int8(A, B, out_dtype=jnp.float32):
     Per-row (A) / per-column (B) symmetric int8 quantization with exact
     int32 accumulation and fused dequant; relative error ~1e-2 on
     Gaussian data (see ``ops.pallas_gemm.quantized_matmul``).  Supported
-    layouts: A on one device, or A row-chunked on an even ``(p, 1)``
-    grid with B resident/replicated (each rank quantizes its own rows —
-    row-wise scales are local by construction).  Anything else raises:
-    this is an opt-in performance API, not a silently-degrading one.
+    layouts: A on one device; A row-chunked on an even ``(p, 1)`` grid
+    with B resident/replicated (each rank quantizes its own rows —
+    row-wise scales are local by construction); or A and B both on the
+    SAME even square ``(g, g)`` grid (the BLAS-3 tile shape — int8
+    panels + per-panel scales ride the Cannon double ring,
+    ``cannon_matmul_int8``).  Anything else raises: this is an opt-in
+    performance API, not a silently-degrading one.
     """
     if isinstance(A, (SubDArray,)):
         A = A.materialize()      # route through the supported-layout pick
@@ -459,6 +493,14 @@ def dmatmul_int8(A, B, out_dtype=jnp.float32):
     if p == 1:
         res = quantized_matmul(A.garray, bv, out_dtype=out_dtype)
         return _wrap_global(res, procs=procs, dist=[1, 1])
+    gq = _square_grid_ok(A, B) if isinstance(B, DArray) else None
+    if gq is not None:
+        mesh, axes, fn = _int8_cannon_jit(tuple(procs), gq,
+                                          str(jnp.dtype(out_dtype)))
+        sh = NamedSharding(mesh, P(*axes))
+        a = jax.device_put(A.garray, sh)
+        b = jax.device_put(B.garray, sh)
+        return _wrap_global(fn(a, b), procs=procs, dist=[gq, gq])
     if A.pids.shape != (p, 1) or A._padded or m % p:
         raise ValueError(
             "dmatmul_int8 needs A on one device or row-chunked on an "

@@ -92,6 +92,26 @@ def test_mismatched_layouts_reshard(abc):
     assert r.pids.shape == (8, 1)
 
 
+def test_divisibility_misfit_reshards_without_replication_warning(rng):
+    # NamedSharding accepts uneven shards, so an arg whose dims don't
+    # divide the target mesh axes must go through the real reshard —
+    # replicating it was a memory/bandwidth regression (ADVICE round-4);
+    # only rank misfits replicate (with a warning)
+    import warnings
+    U = rng.standard_normal((50, 8)).astype(np.float32)
+    V = rng.standard_normal((50, 8)).astype(np.float32)
+    du = dat.distribute(U, procs=range(8), dist=(4, 2))   # uneven rows
+    dv = dat.distribute(V, procs=range(4), dist=(2, 2))   # other mesh
+    from distributedarrays_tpu.utils import debug as dbg
+    with dbg._warned_lock:
+        dbg._warned.clear()               # a prior test must not mask it
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")                    # any warn fails
+        r = du + dv
+    assert np.allclose(np.asarray(r), U + V, rtol=1e-6)
+    dat.d_closeall()
+
+
 def test_dmap_into(abc):
     A, B, _ = abc
     da, db = dat.distribute(A), dat.distribute(B)

@@ -378,6 +378,20 @@ def test_dmatmul_int8_row_sharded(rng):
     dat.d_closeall()
 
 
+def test_dmatmul_int8_square_grid(rng):
+    # both operands on one (2,2) grid: int8 panels + per-panel scales
+    # ride the Cannon double ring (cannon_matmul_int8)
+    A = rng.standard_normal((64, 64)).astype(np.float32)
+    B = rng.standard_normal((64, 32)).astype(np.float32)
+    da = dat.distribute(A, procs=range(4), dist=(2, 2))
+    db = dat.distribute(B, procs=range(4), dist=(2, 2))
+    C = dat.dmatmul_int8(da, db)
+    assert list(C.pids.shape) == [2, 2]
+    ref = A @ B
+    assert np.abs(np.asarray(C) - ref).max() / np.abs(ref).max() < 3e-2
+    dat.d_closeall()
+
+
 def test_dmatmul_int8_validation(rng):
     A = rng.standard_normal((50, 64)).astype(np.float32)  # uneven rows
     da = dat.distribute(A, procs=range(4), dist=(4, 1))
