@@ -194,7 +194,9 @@ _START = time.monotonic()
 _GLOBAL_BUDGET_S = float(os.environ.get("DAT_BENCH_BUDGET_S", "3300"))
 
 
-_ONLY = {s for s in os.environ.get("DAT_BENCH_ONLY", "").split(",") if s}
+_ONLY = {s.strip() for s in os.environ.get("DAT_BENCH_ONLY", "").split(",")
+         if s.strip()}
+_SEEN_LABELS: set[str] = set()
 
 
 def _guarded(details, label, fn, timeout_s=420.0):
@@ -209,6 +211,7 @@ def _guarded(details, label, fn, timeout_s=420.0):
     def _remaining():
         return _GLOBAL_BUDGET_S - (time.monotonic() - _START)
 
+    _SEEN_LABELS.add(label)
     if _ONLY and label not in _ONLY:
         details[f"{label}_error"] = "skipped (DAT_BENCH_ONLY)"
         _save(details)
@@ -1098,8 +1101,13 @@ def main():
         # DAT_BENCH_DECODE_STEPS: harness-validation override (the full
         # 2k-step scan is minutes-slow on host CPU, seconds on a chip)
         total = max(int(os.environ.get("DAT_BENCH_DECODE_STEPS", 2032)), 32)
+        # cache length is a SEPARATE knob: the default path must keep the
+        # 2048 KV cache it has always had (a cache resize changes the
+        # per-step attention cost and breaks comparability across runs)
+        cache = max(int(os.environ.get("DAT_BENCH_DECODE_CACHE", 2048)),
+                    total)
         cfg = T.Config(vocab=8192, dim=1024, heads=16, layers=8,
-                       ffn_mult=4, max_seq=total, dtype=jnp.bfloat16)
+                       ffn_mult=4, max_seq=cache, dtype=jnp.bfloat16)
         params = T.init_params(jax.random.key(2), cfg)
         Bd, S0, NEW = 8, 16, total - 16
         prompt = jax.random.randint(jax.random.key(3), (Bd, S0), 0,
@@ -1156,6 +1164,18 @@ def main():
                 f"{tag}_f32_highest_gflops": 2 * K16**3 / t / 1e9}
 
     _guarded(details, f"{tag}_f32_highest", highest16, timeout_s=600)
+
+    # a DAT_BENCH_ONLY entry that matched nothing is a typo that would
+    # otherwise silently cost a short hardware window its target number —
+    # surface it in the details AND on stderr
+    unmatched = sorted(_ONLY - _SEEN_LABELS)
+    if unmatched:
+        details["bench_only_unmatched_labels"] = unmatched
+        details["bench_only_known_labels"] = sorted(_SEEN_LABELS)
+        print(f"bench: DAT_BENCH_ONLY entries matched no config: "
+              f"{unmatched}; known labels: {sorted(_SEEN_LABELS)}",
+              file=sys.stderr)
+        _save(details)
 
     # cleanup may hang on a wedged tunnel: bounded (headline already out)
     _run_with_timeout(dat.d_closeall, 60)

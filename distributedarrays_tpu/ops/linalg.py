@@ -503,8 +503,10 @@ def dmatmul_int8(A, B, out_dtype=jnp.float32):
         return _wrap_global(fn(a, b), procs=procs, dist=[gq, gq])
     if A.pids.shape != (p, 1) or A._padded or m % p:
         raise ValueError(
-            "dmatmul_int8 needs A on one device or row-chunked on an "
-            f"even (p, 1) grid; got grid {A.pids.shape}, dims {A.dims}")
+            "dmatmul_int8 needs A on one device, A row-chunked on an even "
+            "(p, 1) grid with B resident/replicated, or A and B both on "
+            "the SAME even square (g, g) grid (matching rank order, no "
+            f"padding); got grid {A.pids.shape}, dims {A.dims}")
     if isinstance(B, DArray) and B._padded:
         raise ValueError("dmatmul_int8 needs an even (or resident) B")
     mesh, ax, fn = _int8_shm_jit(tuple(procs), p, str(jnp.dtype(out_dtype)))
