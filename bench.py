@@ -423,22 +423,26 @@ def main():
             out["matmul_impl_dist_winner"] = winner
         if len(jax.devices()) >= 4:
             # the 2-D-grid arm (BASELINE config 3's block layout): GSPMD
-            # vs the Cannon double ring on the largest power-of-two
-            # square grid the devices support (an odd isqrt like 5 would
-            # not divide the shape and would crash this section's
-            # already-collected results), at the 16384² config's shape
-            # (scaled by the harness override, rounded to a g multiple)
-            gq = 2
-            while (2 * gq) ** 2 <= len(jax.devices()):
-                gq *= 2
+            # vs the owned tile schedule (Cannon on square grids, SUMMA
+            # panels on rectangles) on the largest power-of-two (r, c)
+            # grid the devices support — power-of-two factors so the
+            # shape rounding below always divides; e.g. 4 -> 2x2,
+            # 8 -> 2x4 (all chips used), 16 -> 4x4 — at the 16384²
+            # config's shape (scaled by the harness override, rounded
+            # to an lcm(r, c) multiple)
+            ndev = len(jax.devices())
+            gr = 2
+            while (2 * gr) * (2 * gr) <= ndev:
+                gr *= 2
+            gc = gr * 2 if gr * gr * 2 <= ndev else gr
             TS = int(os.environ.get("DAT_BENCH_TUNE_N", 4 * N))
-            TS -= TS % gq
+            TS -= TS % max(gr, gc)
             winner, results = _la.tune_matmul_impl_summa(
-                TS, TS, TS, g=gq, timer=chain_timer, persist=persist)
+                TS, TS, TS, g=(gr, gc), timer=chain_timer, persist=persist)
             for impl, t in results.items():
                 if t != float("inf"):
-                    out[f"matmul_impl_summa_{gq}x{gq}_{impl}_s_per_iter"] = t
-            out[f"matmul_impl_summa_{gq}x{gq}_winner"] = winner
+                    out[f"matmul_impl_summa_{gr}x{gc}_{impl}_s_per_iter"] = t
+            out[f"matmul_impl_summa_{gr}x{gc}_winner"] = winner
             out["matmul_impl_summa_n"] = TS
         if persist:
             out["matmul_impl_cache_path"] = autotune.default_cache_path()

@@ -37,7 +37,7 @@ from ..parallel.collectives import pshift
 
 __all__ = ["allgather_matmul", "allgather_matmul_rhs",
            "matmul_reducescatter", "cannon_matmul", "cannon_matmul_int8",
-           "tp_ffn"]
+           "summa_matmul", "tp_ffn"]
 
 
 def _cannon_skew_perms(g: int):
@@ -225,6 +225,59 @@ def cannon_matmul(a, b, row_axis: str, col_axis: str):
         1, g - 1, body,
         (pshift(a, col_axis, -1), pshift(b, row_axis, -1), step(a, b)))
     return acc + step(a, b)
+
+
+def summa_matmul(a, b, row_axis: str, col_axis: str):
+    """2-D-grid GEMM on an ARBITRARY ``(r, c)`` grid — the SUMMA panel
+    schedule, where ``cannon_matmul``'s skewed double ring only serves
+    square grids (its panels misalign mid-ring when ``r != c``).
+
+    ``a``: this rank's ``(m/r, k/c)`` block; ``b``: the ``(k/r, n/c)``
+    block; returns the rank's ``(m/r, n/c)`` block of ``A @ B`` (C never
+    moves).  The contraction splits into ``L = lcm(r, c)`` panels of
+    width ``k/L`` — the finest grain on which A's column blocks and B's
+    row blocks stay aligned.  Panel ``q`` of A lives on grid column
+    ``q // (L/c)`` and of B on grid row ``q // (L/r)``; each step
+    broadcasts both panels (a masked ``psum`` — the XLA-native broadcast
+    inside shard_map) and accumulates one local matmul.  The loop is
+    unrolled in Python (L is static and small for real grids), so every
+    slice offset is static and XLA's latency-hiding scheduler can
+    overlap step ``q+1``'s collectives with step ``q``'s matmul.
+
+    vs plain GSPMD (which all-gathers A along ``c`` AND B along ``r``,
+    materializing a full ``(m/r, k)`` + ``(k, n/c)`` per rank): ~2x the
+    wire (psum = reduce+broadcast), but peak memory stays
+    O(one panel) — the reason SUMMA exists at 16384²-class shapes.
+    Promotion is by measurement like every owned schedule
+    (``linalg.tune_matmul_impl_summa``; GSPMD is the fallback).
+    """
+    import math as _math
+    r = lax.axis_size(row_axis)
+    c = lax.axis_size(col_axis)
+    out_dtype = jnp.result_type(a.dtype, b.dtype)
+    if r == 1 and c == 1:
+        return (a @ b).astype(out_dtype)
+    L = _math.lcm(r, c)
+    k_loc_a = a.shape[1]            # k/c
+    k_loc_b = b.shape[0]            # k/r
+    if k_loc_a % (L // c) or k_loc_b % (L // r):
+        raise ValueError(
+            f"summa_matmul needs k divisible by lcm(r, c) = {L}")
+    kp = k_loc_a // (L // c)        # == k/L == k_loc_b // (L // r)
+    i = lax.axis_index(row_axis)
+    j = lax.axis_index(col_axis)
+    acc = jnp.zeros((a.shape[0], b.shape[1]), out_dtype)
+    for q in range(L):
+        ca, oa = divmod(q, L // c)  # A panel q: grid col ca, local slot oa
+        rb, ob = divmod(q, L // r)  # B panel q: grid row rb, local slot ob
+        a_sl = lax.dynamic_slice_in_dim(a, oa * kp, kp, 1)
+        b_sl = lax.dynamic_slice_in_dim(b, ob * kp, kp, 0)
+        a_pan = lax.psum(jnp.where(j == ca, a_sl, jnp.zeros_like(a_sl)),
+                         col_axis)
+        b_pan = lax.psum(jnp.where(i == rb, b_sl, jnp.zeros_like(b_sl)),
+                         row_axis)
+        acc = acc + (a_pan @ b_pan).astype(out_dtype)
+    return acc
 
 
 def cannon_matmul_int8(a, b, row_axis: str, col_axis: str,

@@ -295,20 +295,21 @@ def _dist_impl_choice(m, n, k, p, a_dtype, b_dtype):
         "matmul_impl_dist", _impl_key(m, n, k, p, a_dtype, b_dtype)) or "jnp"
 
 
-def _square_grid_ok(A: DArray, B):
-    """Shared (g,g)×(g,g) eligibility core for the Cannon schedules
-    (``matmul``'s summa dispatch AND ``dmatmul_int8``'s grid branch —
-    one owner, so the rules cannot diverge): both operands DArrays on
-    the SAME square rank grid, unpadded (⇒ even chunks on every axis),
-    fully addressable (eager device_put cannot move bytes between
-    hosts — same guard as ``_ring_ag_eligible``; ADVICE round-4).
-    Returns ``g`` (>= 2) or ``None``."""
+def _grid2d_ok(A: DArray, B):
+    """Shared 2-D-grid eligibility core for the owned tile schedules
+    (``matmul``'s summa/cannon dispatch AND ``dmatmul_int8``'s grid
+    branch — one owner, so the rules cannot diverge): both operands
+    DArrays on the SAME ``(r, c)`` rank grid (identical flat rank
+    order), unpadded (⇒ even chunks on every axis), fully addressable
+    (eager device_put cannot move bytes between hosts — same guard as
+    ``_ring_ag_eligible``; ADVICE round-4).  Returns ``(r, c)`` with
+    ``r * c >= 2`` ranks, or ``None``."""
     if not isinstance(B, DArray):
         return None
     if A.pids.ndim != 2 or B.pids.ndim != 2:
         return None
-    g = A.pids.shape[0]
-    if g < 2 or A.pids.shape != (g, g) or B.pids.shape != (g, g):
+    r, c = A.pids.shape
+    if r * c < 2 or B.pids.shape != (r, c):
         return None
     if [int(q) for q in B.pids.flat] != [int(q) for q in A.pids.flat]:
         return None
@@ -316,50 +317,74 @@ def _square_grid_ok(A: DArray, B):
         return None
     if not (A.garray.is_fully_addressable and B.garray.is_fully_addressable):
         return None
-    return g
+    return r, c
+
+
+def _square_grid_ok(A: DArray, B):
+    """``_grid2d_ok`` restricted to square ``(g, g)`` grids with
+    ``g >= 2`` — the Cannon-ring shapes.  Returns ``g`` or ``None``."""
+    rc = _grid2d_ok(A, B)
+    if rc is None or rc[0] != rc[1] or rc[0] < 2:
+        return None
+    return rc[0]
 
 
 def _summa_eligible(A: DArray, B, procs, dist):
-    """The square 2-D-grid shape the Cannon schedule serves: A and B on
-    the SAME ``(g, g)`` rank grid, result on that grid too — the
-    reference's tile-grid ``mul!`` (linalg.jl:189-253) and BASELINE
-    config 3 (16384² on 2×2).  Plain GSPMD SUMMAs this itself; the
-    owned schedule pipelines both panel rings behind the local GEMMs and
-    must earn its place by measurement (``_summa_impl_choice``)."""
-    g = _square_grid_ok(A, B)
-    if g is None:
-        return False
+    """The 2-D-grid shape the owned tile schedules serve: A and B on the
+    SAME ``(r, c)`` rank grid, result on that grid too — the reference's
+    tile-grid ``mul!`` (linalg.jl:189-253) and BASELINE config 3 (16384²
+    on 2×2).  Square grids run the Cannon double ring; rectangular ones
+    the masked-psum SUMMA panel schedule.  Plain GSPMD SUMMAs this
+    itself; the owned schedules must earn their place by measurement
+    (``_summa_impl_choice``).  Returns ``(r, c)`` or ``None``."""
+    rc = _grid2d_ok(A, B)
+    if rc is None:
+        return None
+    r, c = rc
+    # degenerate 1-D grids belong to the ring-AG/GSPMD tiers
+    if r < 2 or c < 2:
+        return None
     aprocs = [int(q) for q in A.pids.flat]
-    if list(dist) != [g, g] or [int(q) for q in procs[:g * g]] != aprocs:
-        return False
-    # even chunking everywhere the double ring assumes it: m and n by g,
-    # k by g along BOTH grid axes (A splits k over columns, B over rows)
+    if list(dist) != [r, c] or [int(q) for q in procs[:r * c]] != aprocs:
+        return None
+    # even chunking everywhere the schedules assume it: m by r, n by c,
+    # k by lcm(r, c) (A splits k over columns, B over rows; the SUMMA
+    # panel width is k/lcm — for square grids lcm == g)
     m, k = A.dims
     n = B.dims[1]
-    return m % g == 0 and n % g == 0 and k % g == 0
+    if m % r or n % c or k % math.lcm(r, c):
+        return None
+    return rc
 
 
-def _summa_impl_choice(m, n, k, g, a_dtype, b_dtype):
-    """Registry choice for the square-grid GEMM: ``"summa"`` (the Cannon
-    double ring) or ``"jnp"`` (GSPMD).  Shares the ``matmul_impl_dist``
-    registry with the 1-D ring, fenced by a ``gxg`` grid tag in the key
-    so a (p,1) promotion never fires the 2-D schedule or vice versa."""
+def _summa_impl_choice(m, n, k, r, c, a_dtype, b_dtype):
+    """Registry choice for the 2-D-grid GEMM: ``"summa"`` (the owned
+    tile schedule — Cannon double ring on square grids, masked-psum
+    SUMMA panels on rectangular ones) or ``"jnp"`` (GSPMD).  Shares the
+    ``matmul_impl_dist`` registry with the 1-D ring, fenced by an
+    ``rxc`` grid tag in the key so a (p,1) promotion never fires the
+    2-D schedule or vice versa."""
     from ..utils import autotune
     return autotune.get(
         "matmul_impl_dist",
-        _impl_key(m, n, k, f"{g}x{g}", a_dtype, b_dtype)) or "jnp"
+        _impl_key(m, n, k, f"{r}x{c}", a_dtype, b_dtype)) or "jnp"
 
 
 @functools.lru_cache(maxsize=None)
-def _summa_jit(procs, g, out_dtype_str):
-    """One shard_map program for the square-grid GEMM: Cannon pre-skew +
-    overlapped double panel ring (``cannon_matmul``)."""
-    from .collective_matmul import cannon_matmul
-    mesh = L.mesh_for(procs, (g, g))
+def _summa_jit(procs, r, c, out_dtype_str):
+    """One shard_map program for the 2-D-grid GEMM: Cannon pre-skew +
+    overlapped double panel ring on square grids (``cannon_matmul``),
+    masked-psum SUMMA panels on rectangular ones (``summa_matmul``)."""
+    from .collective_matmul import cannon_matmul, summa_matmul
+    mesh = L.mesh_for(procs, (r, c))
     ax_r, ax_c = mesh.axis_names
 
-    def prog(a, b):
-        return cannon_matmul(a, b, ax_r, ax_c).astype(out_dtype_str)
+    if r == c:
+        def prog(a, b):
+            return cannon_matmul(a, b, ax_r, ax_c).astype(out_dtype_str)
+    else:
+        def prog(a, b):
+            return summa_matmul(a, b, ax_r, ax_c).astype(out_dtype_str)
 
     shm = jax.shard_map(prog, mesh=mesh,
                         in_specs=(P(ax_r, ax_c), P(ax_r, ax_c)),
@@ -368,11 +393,12 @@ def _summa_jit(procs, g, out_dtype_str):
 
 
 def _summa_gemm(A: DArray, B: DArray, out_dtype):
-    """Run the eligible square-grid GEMM as the Cannon program; returns
-    the (g,g)-block-sharded result array."""
-    g = A.pids.shape[0]
+    """Run the eligible 2-D-grid GEMM as the owned tile program; returns
+    the (r,c)-block-sharded result array."""
+    r, c = A.pids.shape
     procs = tuple(int(q) for q in A.pids.flat)
-    mesh, (ax_r, ax_c), fn = _summa_jit(procs, g, str(jnp.dtype(out_dtype)))
+    mesh, (ax_r, ax_c), fn = _summa_jit(procs, r, c,
+                                        str(jnp.dtype(out_dtype)))
     sh = NamedSharding(mesh, P(ax_r, ax_c))
     a = jax.device_put(A.garray, sh)
     b = jax.device_put(B.garray, sh)
@@ -566,23 +592,28 @@ def tune_matmul_impl_dist(m, n, k, p=None, dtype=jnp.float32, timer=None,
 
 def tune_matmul_impl_summa(m, n, k, g=None, dtype=jnp.float32, timer=None,
                            persist=True):
-    """Measure GSPMD vs the Cannon double ring (`cannon_matmul`) for the
-    square-grid GEMM — A and B block-distributed over a ``(g, g)`` device
-    grid (BASELINE config 3's 2×2 shape) — and bank the winner under
-    ``matmul_impl_dist`` with a ``gxg`` grid tag (consulted by ``matmul``
-    for eligible (g,g)×(g,g) DArray operands).  ``g`` defaults to the
-    largest square grid the local devices support; requires
-    ``m % g == n % g == k % g == 0``."""
+    """Measure GSPMD vs the owned 2-D tile schedule — the Cannon double
+    ring (`cannon_matmul`) on square grids, the masked-psum SUMMA panels
+    (`summa_matmul`) on rectangular ones — for A and B block-distributed
+    over an ``(r, c)`` device grid (BASELINE config 3's 2×2 shape), and
+    bank the winner under ``matmul_impl_dist`` with an ``rxc`` grid tag
+    (consulted by ``matmul`` for eligible same-grid DArray operands).
+    ``g``: an int (square ``(g, g)`` grid) or an ``(r, c)`` tuple;
+    defaults to the largest square grid the local devices support.
+    Requires ``m % r == n % c == k % lcm(r, c) == 0``."""
     if g is None:
         g = int(math.isqrt(len(jax.devices())))
-    if g < 2:
-        raise ValueError("tune_matmul_impl_summa needs >= 4 devices "
-                         "(a >= 2x2 grid)")
-    if m % g or n % g or k % g:
+    r, c = (g, g) if isinstance(g, int) else (int(g[0]), int(g[1]))
+    if r < 2 or c < 2:
+        raise ValueError("tune_matmul_impl_summa needs a >= 2x2 grid "
+                         "(>= 4 devices for the default square)")
+    if m % r or n % c or k % math.lcm(r, c):
         raise ValueError(
-            f"m ({m}), n ({n}) and k ({k}) must be divisible by g ({g})")
-    procs = tuple(range(g * g))
-    mesh, (ax_r, ax_c), cannon = _summa_jit(procs, g, str(jnp.dtype(dtype)))
+            f"m ({m}), n ({n}), k ({k}) must be divisible by r ({r}), "
+            f"c ({c}), lcm(r, c) ({math.lcm(r, c)}) respectively")
+    procs = tuple(range(r * c))
+    mesh, (ax_r, ax_c), owned = _summa_jit(procs, r, c,
+                                           str(jnp.dtype(dtype)))
     sh = NamedSharding(mesh, P(ax_r, ax_c))
     a = jax.device_put(jax.random.normal(
         jax.random.PRNGKey(0), (m, k), jnp.float32).astype(dtype), sh)
@@ -590,8 +621,8 @@ def tune_matmul_impl_summa(m, n, k, g=None, dtype=jnp.float32, timer=None,
         jax.random.PRNGKey(1), (k, n), jnp.float32).astype(dtype), sh)
     gspmd = jax.jit(jnp.matmul, out_shardings=sh)
     return _tune_impls(
-        "matmul_impl_dist", _impl_key(m, n, k, f"{g}x{g}", a.dtype, b.dtype),
-        {"jnp": gspmd, "summa": cannon}, a, b,
+        "matmul_impl_dist", _impl_key(m, n, k, f"{r}x{c}", a.dtype, b.dtype),
+        {"jnp": gspmd, "summa": owned}, a, b,
         timer or _default_impl_timer, persist)
 
 
@@ -667,8 +698,8 @@ def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
             return C
         return _wrap_global(res, procs=procs, dist=dist)
     if (not use_ab and not vec
-            and _summa_eligible(A, B, procs, dist)
-            and _summa_impl_choice(m, n, k, A.pids.shape[0],
+            and (_rc := _summa_eligible(A, B, procs, dist)) is not None
+            and _summa_impl_choice(m, n, k, _rc[0], _rc[1],
                                    A.dtype, B.dtype) == "summa"):
         res = _summa_gemm(A, B, out_dtype)
         res = jax.device_put(res, sharding)

@@ -271,7 +271,8 @@ def test_matmul_summa_dispatch(rng, monkeypatch):
     la.mul_into(C3, da, db, alpha=2.0)
     assert not called
     assert np.allclose(np.asarray(C3), 2 * (A @ B), rtol=1e-4, atol=1e-4)
-    # a rectangular grid is NOT eligible even with a banked entry
+    # MISMATCHED grids ((2,4) A vs (4,2) B) are NOT eligible even with a
+    # banked entry — the tile schedules need both operands on ONE grid
     da2 = dat.distribute(A, procs=range(8), dist=(2, 4))
     db2 = dat.distribute(B, procs=range(8), dist=(4, 2))
     autotune.record("matmul_impl_dist",
@@ -281,6 +282,33 @@ def test_matmul_summa_dispatch(rng, monkeypatch):
     C4 = da2 @ db2
     assert not called
     assert np.allclose(np.asarray(C4), A @ B, rtol=1e-4, atol=1e-4)
+    autotune.clear()
+    dat.d_closeall()
+
+
+def test_matmul_summa_rectangular_dispatch(rng, monkeypatch):
+    # a SAME-grid rectangular (2,4) layout routes to the masked-psum
+    # SUMMA panel schedule when promoted (square grids take Cannon)
+    from distributedarrays_tpu.utils import autotune
+    autotune.clear()
+    A = rng.standard_normal((16, 24)).astype(np.float32)
+    B = rng.standard_normal((24, 8)).astype(np.float32)
+    da = dat.distribute(A, procs=range(8), dist=(2, 4))
+    db = dat.distribute(B, procs=range(8), dist=(2, 4))
+    called = []
+    orig = la._summa_gemm
+    monkeypatch.setattr(la, "_summa_gemm",
+                        lambda *a: called.append(1) or orig(*a))
+    C0 = da @ db                       # default: GSPMD
+    assert not called
+    assert np.allclose(np.asarray(C0), A @ B, rtol=1e-4, atol=1e-4)
+    autotune.record("matmul_impl_dist",
+                    la._impl_key(16, 8, 24, "2x4", da.dtype, db.dtype),
+                    "summa")
+    C1 = da @ db
+    assert called, "banked rect-grid win must route through summa_matmul"
+    assert np.allclose(np.asarray(C1), A @ B, rtol=1e-4, atol=1e-4)
+    assert list(C1.pids.shape) == [2, 4]
     autotune.clear()
     dat.d_closeall()
 
@@ -305,6 +333,12 @@ def test_tune_matmul_impl_summa_banks_winner():
                         la._impl_key(16, 8, 24, "2x2", f32, f32)) == "summa"
     with pytest.raises(ValueError, match="divisible"):
         la.tune_matmul_impl_summa(15, 8, 24, g=2, timer=timer)
+    # rectangular grid: same flow, rxc-tagged key
+    winner, results = la.tune_matmul_impl_summa(
+        16, 8, 24, g=(2, 4), timer=lambda op, a, b: 1.0, persist=False)
+    assert set(results) == {"jnp", "summa"}
+    assert autotune.get("matmul_impl_dist",
+                        la._impl_key(16, 8, 24, "2x4", f32, f32)) is not None
     autotune.clear()
 
 
