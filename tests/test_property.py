@@ -231,3 +231,33 @@ def test_moe_topk_matches_oracle_any_k_capacity(k, cap, seed):
     got = np.asarray(M.moe_forward(params, x, mesh, capacity=cap, k=k))
     want = M.reference_moe(params, np.asarray(x), cap, 4, k=k)
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+@settings(max_examples=12, deadline=None)
+@given(grid=st.sampled_from([(2, 2), (2, 4), (4, 2)]),
+       mm=st.integers(1, 4), nn=st.integers(1, 4), kk=st.integers(1, 3),
+       seed=st.integers(0, 2 ** 16))
+def test_tile_grid_gemm_matches_numpy(grid, mm, nn, kk, seed):
+    # the owned 2-D tile schedules (Cannon ring on square grids, SUMMA
+    # panels on rectangles) over random compatible shapes must match the
+    # numpy oracle — promotion forced through the registry like dispatch
+    from distributedarrays_tpu.ops import linalg as la
+    from distributedarrays_tpu.utils import autotune
+    r, c = grid
+    lcm = int(np.lcm(r, c))
+    m, n, k = mm * r, nn * c, kk * lcm
+    rng2 = np.random.default_rng(seed)
+    A = rng2.standard_normal((m, k)).astype(np.float32)
+    B = rng2.standard_normal((k, n)).astype(np.float32)
+    da = dat.distribute(A, procs=range(r * c), dist=(r, c))
+    db = dat.distribute(B, procs=range(r * c), dist=(r, c))
+    autotune.record("matmul_impl_dist",
+                    la._impl_key(m, n, k, f"{r}x{c}", da.dtype, db.dtype),
+                    "summa")
+    try:
+        got = np.asarray(da @ db)
+    finally:
+        autotune.clear()
+        da.close()
+        db.close()
+    np.testing.assert_allclose(got, A @ B, rtol=1e-4, atol=1e-4)
