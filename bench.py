@@ -192,6 +192,10 @@ def _save(details):
 _START = time.monotonic()
 # headroom under the driver's own timeout; env override for harness tests
 _GLOBAL_BUDGET_S = float(os.environ.get("DAT_BENCH_BUDGET_S", "3300"))
+# targeted reruns can afford longer per-config windows: round 5's first
+# hardware pass showed a full flash sweep overruns the default 900s when
+# every arm pays a fresh remote compile through the tunnel
+_TSCALE = float(os.environ.get("DAT_BENCH_TIMEOUT_SCALE", "1"))
 
 
 _ONLY = {s.strip() for s in os.environ.get("DAT_BENCH_ONLY", "").split(",")
@@ -213,21 +217,21 @@ def _guarded(details, label, fn, timeout_s=420.0):
 
     _SEEN_LABELS.add(label)
     if _ONLY and label not in _ONLY:
-        details[f"{label}_error"] = "skipped (DAT_BENCH_ONLY)"
-        _save(details)
+        # no marker write: a targeted rerun must not stamp skip-"errors"
+        # over the seeded master table's banked results (review round-5)
         return
     if _remaining() < 60:
         details[f"{label}_error"] = "skipped (global bench deadline)"
         _save(details)
         return
-    effective = min(timeout_s, _remaining())
+    effective = min(timeout_s * _TSCALE, _remaining())
     finished, res, thread = _run_with_timeout(fn, effective)
     if finished and isinstance(res, Exception) and \
             "remote_compile" in str(res) and _remaining() > 75:
         # transient tunnel-service flake (observed: response body closed
         # mid-read); one retry after a settle pause
         time.sleep(15)
-        effective = min(timeout_s, _remaining())
+        effective = min(timeout_s * _TSCALE, _remaining())
         finished, res, thread = _run_with_timeout(fn, effective)
     if not finished:
         details[f"{label}_error"] = f"timed out after {effective:.0f}s"
@@ -300,6 +304,34 @@ def main():
         },
     }
 
+    _prior_direct = False
+    if _ONLY:
+        # Targeted rerun: seed from the banked table so ONE master file
+        # accumulates across invocations.  Running one config per process
+        # is the fix for round 5's first-pass failure mode — a sweep that
+        # times out leaves an orphan daemon thread still dispatching, and
+        # every later config in the same process times against that load.
+        try:
+            prior = json.loads(cur.read_text()) if cur.exists() else {}
+        except Exception:
+            prior = {}
+        for lbl in _ONLY:
+            prior.pop(f"{lbl}_error", None)
+            prior.pop(f"{lbl}_orphan_running", None)
+        for k in ("bench_only_unmatched_labels", "bench_only_known_labels"):
+            prior.pop(k, None)
+        prior_prov = prior.pop("_provenance", None)
+        prior_provs = prior.pop("_prior_provenances", [])
+        details.update(prior)
+        if prior_prov is not None:
+            prior_provs = prior_provs + [prior_prov]
+        if prior_provs:
+            details["_prior_provenances"] = prior_provs
+        # a banked headline is only reusable if it came from the direct
+        # t(L)/L method — never reprint a distrusted-format table's number
+        _prior_direct = bool(prior_prov) and \
+            "direct" in str(prior_prov.get("method", ""))
+
     # ---- config 0 (headline): 4096^2 GEMM, DEFAULT precision ------------
     N = 4096
     dat.seed(7)
@@ -320,24 +352,36 @@ def main():
         return gemm_chain
 
     chain = gemm_chain_at(jax.lax.Precision.DEFAULT)
-    t_gemm, L_used = _periter(chain, L0=64)
-    gflops = 2 * N**3 / t_gemm / 1e9
-    details["gemm_4096_mixed_bf16pass_s_per_iter"] = t_gemm
-    details["gemm_4096_mixed_bf16pass_L"] = L_used
-    details["gemm_4096_mixed_bf16pass_gflops"] = gflops
-    _bank_tflops(details, "gemm_4096_mixed_bf16pass", gflops / 1e3, peak)
-    (A @ B).garray                         # compile the eager path
-    details["gemm_4096_mixed_bf16pass_eager_latency_s"] = _t(
-        lambda: (A @ B).garray)
-    _save(details)
+    # in a targeted rerun the headline is usually already banked — don't
+    # re-pay its ~2 min before the config the short window is aimed at
+    _SEEN_LABELS.add("headline")
+    _have_headline = ("gemm_4096_mixed_bf16pass_gflops" in details
+                      and "gemm_4096_mixed_bf16pass_s_per_iter" in details
+                      and "cpu_numpy_gflops" in details
+                      and _prior_direct)
+    if not _ONLY or "headline" in _ONLY or not _have_headline:
+        t_gemm, L_used = _periter(chain, L0=64)
+        gflops = 2 * N**3 / t_gemm / 1e9
+        details["gemm_4096_mixed_bf16pass_s_per_iter"] = t_gemm
+        details["gemm_4096_mixed_bf16pass_L"] = L_used
+        details["gemm_4096_mixed_bf16pass_gflops"] = gflops
+        _bank_tflops(details, "gemm_4096_mixed_bf16pass", gflops / 1e3, peak)
+        (A @ B).garray                     # compile the eager path
+        details["gemm_4096_mixed_bf16pass_eager_latency_s"] = _t(
+            lambda: (A @ B).garray)
+        _save(details)
 
-    # ---- CPU baseline: same GEMM in numpy (host BLAS) --------------------
-    an = np.asarray(A, dtype=np.float32)
-    bn = np.asarray(B, dtype=np.float32)
-    t_np = min(_t(lambda: an @ bn) for _ in range(2))
-    cpu_gflops = 2 * N**3 / t_np / 1e9
-    details["cpu_numpy_gflops"] = cpu_gflops
-    _save(details)
+        # ---- CPU baseline: same GEMM in numpy (host BLAS) ----------------
+        an = np.asarray(A, dtype=np.float32)
+        bn = np.asarray(B, dtype=np.float32)
+        t_np = min(_t(lambda: an @ bn) for _ in range(2))
+        cpu_gflops = 2 * N**3 / t_np / 1e9
+        details["cpu_numpy_gflops"] = cpu_gflops
+        _save(details)
+    else:
+        gflops = details["gemm_4096_mixed_bf16pass_gflops"]
+        cpu_gflops = details["cpu_numpy_gflops"]
+        t_gemm = details["gemm_4096_mixed_bf16pass_s_per_iter"]
 
     # headline out NOW: everything after this point is banked detail, and a
     # tunnel wedge in a later config must not cost the round its one JSON
@@ -349,11 +393,15 @@ def main():
         "vs_baseline": round(gflops / cpu_gflops, 2),
     }), flush=True)
 
-    # sum(A.^2) half of config 0 (after the headline: banked detail only)
-    float(dat.dmapreduce(jnp.square, "sum", A))
-    details["sum_sq_4096_eager_s"] = _t(
-        lambda: float(dat.dmapreduce(jnp.square, "sum", A)))
-    _save(details)
+    if not _ONLY or "headline" in _ONLY:
+        # sum(A.^2) half of config 0 (after the headline: detail only).
+        # In targeted mode this runs ONLY when explicitly asked: it is
+        # unguarded (no per-config timeout), and a wedge here would cost
+        # the config the short hardware window was aimed at.
+        float(dat.dmapreduce(jnp.square, "sum", A))
+        details["sum_sq_4096_eager_s"] = _t(
+            lambda: float(dat.dmapreduce(jnp.square, "sum", A)))
+        _save(details)
 
     # methodology cross-check on the SAME op: the round-2 marginal
     # estimator vs the banked direct number (agreement ratio recorded; a

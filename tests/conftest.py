@@ -19,6 +19,17 @@ _ON_REAL = os.environ.get("DAT_TEST_TPU") == "1"
 
 if not _ON_REAL:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # A WEDGED tunnel (connection alive but hung, unlike a refused one)
+    # blocks jax backend discovery even in CPU mode — the axon plugin on
+    # the import path dials the relay during plugin enumeration (observed
+    # round 5).  The CPU suite never needs that backend: drop the plugin
+    # site from this process AND from children's PYTHONPATH (multihost
+    # tests fork subprocesses that must not hang either).
+    import sys as _sys
+    _sys.path[:] = [p for p in _sys.path if ".axon_site" not in p]
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (
