@@ -1,0 +1,65 @@
+"""Drift-guard for the pass-2 bench runner (tools/bench_pass2.py).
+
+The runner decides whether a config is banked by looking for ONE sentinel
+result key per label in BENCH_DETAILS.json.  Those sentinels are copies of
+key literals inside bench.py's config closures; if a bench.py key is
+renamed, the runner would silently re-run (or worse, never re-run) that
+config.  Pin the correspondence textually: every sentinel must appear in
+bench.py — either verbatim or, for the two grid-tagged gemm_16k keys,
+via its f-string template.
+"""
+
+import importlib.util
+import re
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def bp2():
+    spec = importlib.util.spec_from_file_location(
+        "bench_pass2", REPO / "tools" / "bench_pass2.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench_src():
+    return (REPO / "bench.py").read_text()
+
+
+def test_every_batch_label_has_a_sentinel(bp2):
+    missing = [lbl for lbl, _, _ in bp2.BATCHES if lbl not in bp2.SENTINELS]
+    assert not missing, missing
+
+
+def test_every_batch_label_is_a_bench_config(bp2, bench_src):
+    # labels are the second argument of _guarded(details, "label", ...);
+    # the gemm_16k pair is f-string-tagged with the device-count grid
+    labels = set(re.findall(r'_guarded\(details,\s*"([^"]+)"', bench_src))
+    for lbl, _, _ in bp2.BATCHES:
+        if lbl.startswith("gemm_16k_"):
+            assert 'tag = f"gemm_16k_{g3[0]}x{g3[1]}"' in bench_src
+            continue
+        assert lbl in labels, (lbl, sorted(labels))
+
+
+def test_every_sentinel_key_exists_in_bench(bp2, bench_src):
+    for lbl, key in bp2.SENTINELS.items():
+        if lbl.startswith("gemm_16k_"):
+            # key is built as f"{tag}..." — check the suffix template
+            suffix = key.removeprefix("gemm_16k_1x1")
+            assert f'"{{tag}}{suffix}"' in bench_src or \
+                f'f"{{tag}}{suffix}"' in bench_src, key
+            continue
+        # _bank_tflops-generated keys end in _tflops/_mfu/_tops; the
+        # sentinel must be the literal passed as the entry name + unit
+        m = re.fullmatch(r"(.+)_(tflops|tops|mfu)", key)
+        if m and f'"{key}"' not in bench_src:
+            assert f'"{m.group(1)}"' in bench_src, key
+            continue
+        assert f'"{key}"' in bench_src, key

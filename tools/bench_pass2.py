@@ -111,10 +111,10 @@ def banked(label):
     return f"{label}_error" not in d and _has_any_key(d, label)
 
 
-def _has_any_key(d, label):
-    # a config that ran successfully merged at least one non-error key;
-    # match on the config's key prefix conventions
-    sentinels = {
+# one result key each config is guaranteed to merge on success — pinned
+# against bench.py's literals by tests/test_bench_pass2.py so the two
+# files cannot drift apart silently
+SENTINELS = {
         "flash_attn_d128": "flash_attn_d128_tuned_block",
         "flash_attn_tune": "flash_attn_tuned_block",
         "flash_attn_full": "flash_attn_full_tuned_block",
@@ -134,10 +134,13 @@ def _has_any_key(d, label):
         "broadcast_chain": "broadcast_chain_8192_s_per_iter",
         "mapreduce": "mapreduce_1e8_s_per_iter",
         "sort": "sort_1e7_s",
-        "gemm_f32_highest": "gemm_4096_f32_highest_gflops",
-        "gemm_16k_1x1_f32_highest": "gemm_16k_1x1_f32_highest_gflops",
-    }
-    return sentinels.get(label) in d
+    "gemm_f32_highest": "gemm_4096_f32_highest_gflops",
+    "gemm_16k_1x1_f32_highest": "gemm_16k_1x1_f32_highest_gflops",
+}
+
+
+def _has_any_key(d, label):
+    return SENTINELS.get(label) in d
 
 
 def run_label(label, budget, scale):
