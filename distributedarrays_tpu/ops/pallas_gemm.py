@@ -38,20 +38,33 @@ __all__ = ["pallas_matmul", "pallas_matmul_int8", "quantized_matmul",
            "quantize_rows"]
 
 
+# Scoped-VMEM budget for a GEMM tile set: v5e enforces a 16 MiB limit on
+# the Pallas stack allocation (measured on silicon: a 17.38M int8 tile set
+# was rejected with ~0.4M of Mosaic overhead on top of the raw block
+# bytes), so tiles are validated against 15.5 MiB regardless of where the
+# block came from (cache entry, explicit block=, heuristic).
+_VMEM_LIMIT = int(15.5 * 2**20)
+
+
 def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
-                   caps, m_align):
+                   caps, m_align, vmem_bytes=None):
     """Shared block-resolution path for the GEMM kernels: explicit
     ``block`` > valid autotune-cache entry > auto heuristic (whole dim
     when under the cap, else largest power-of-two divisor).  A
     stale/hand-edited/malformed cache entry must degrade to the auto
     heuristic, never break dispatch — validation includes the Mosaic
     alignment rules (last dim % 128, second-to-last % ``m_align``, or
-    equal to the array dim); only real TPUs enforce them, interpret mode
-    runs any tiling."""
+    equal to the array dim) and, when the caller supplies a
+    ``vmem_bytes(bm, bn, bk)`` estimator, the scoped-VMEM budget; only
+    real TPUs enforce either, interpret mode runs any tiling."""
     def aligned(tm, tn, tk):
         return ((tm % m_align == 0 or tm == m)
                 and (tn % 128 == 0 or tn == n)
                 and (tk % 128 == 0 or tk == k))
+
+    def vmem_ok(tm, tn, tk):
+        return (interpret or vmem_bytes is None
+                or vmem_bytes(tm, tn, tk) <= _VMEM_LIMIT)
 
     if block is None:
         from ..utils import autotune
@@ -61,7 +74,8 @@ def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
         if vals is not None:
             tm, tn, tk = vals
             if (m % tm == 0 and n % tn == 0 and k % tk == 0
-                    and (interpret or aligned(tm, tn, tk))):
+                    and (interpret or aligned(tm, tn, tk))
+                    and vmem_ok(tm, tn, tk)):
                 block = (tm, tn, tk)
     if block is None:
         bm0, bn0, bk0 = caps
@@ -77,6 +91,13 @@ def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
     else:
         bm, bn, bk = block
         bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+        if not vmem_ok(bm, bn, bk):
+            # fail at dispatch with the budget, not deep in Mosaic with a
+            # scoped-vmem stack OOM (the silicon failure mode this guards)
+            raise ValueError(
+                f"block {(bm, bn, bk)} needs ~{vmem_bytes(bm, bn, bk)} "
+                f"bytes of scoped VMEM (double-buffered tiles + scratch), "
+                f"over the {_VMEM_LIMIT} budget; pass a smaller block=")
     if m % bm or n % bn or k % bk:
         raise ValueError(
             f"shapes ({m},{k})x({k},{n}) must divide block {(bm, bn, bk)}")
@@ -168,11 +189,20 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
         interpret = not _on_tpu()
     two_byte = max(jnp.dtype(a.dtype).itemsize,
                    jnp.dtype(b.dtype).itemsize) <= 2
+    out_dtype = jnp.result_type(a.dtype, b.dtype)
+    ab, bb = jnp.dtype(a.dtype).itemsize, jnp.dtype(b.dtype).itemsize
+    ob = jnp.dtype(out_dtype).itemsize
+
+    def _vmem(tm, tn, tk):
+        # double-buffered in/out blocks + the f32 acc scratch
+        return 2 * (tm * tk * ab + tk * tn * bb) + 2 * tm * tn * ob \
+            + tm * tn * 4
+
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul",
         dtype_key=(a.dtype, b.dtype),
-        caps=(1024, 1024, 512) if two_byte else (512, 512, 512), m_align=8)
-    out_dtype = jnp.result_type(a.dtype, b.dtype)
+        caps=(1024, 1024, 512) if two_byte else (512, 512, 512), m_align=8,
+        vmem_bytes=_vmem)
     fn = _build(m, n, ka, bm, bn, bk, str(out_dtype), epilogue, interpret)
     return fn(a, b)
 
@@ -271,10 +301,23 @@ def pallas_matmul_int8(qa, qb, a_scale, b_scale,
                   f"int32-exact bound (K <= {safe_k}); saturated operands "
                   "may wrap. Split the contraction if inputs can saturate.")
     # int8 tiles are half the bytes of bf16, so the K cap doubles; int8
-    # native MXU tiling wants the M block % 32
+    # native MXU tiling wants the M block % 32.  The M cap stays at 512:
+    # at 1024^3 the double-buffered working set (2x(a+b+scales) +
+    # 2x f32 out + int32 acc scratch) is 17.4 MB, over v5e's 16 MB scoped
+    # VMEM limit (measured OOM on silicon, round 5); 512x1024x1024 is
+    # ~9.7 MB with the same K-step arithmetic intensity
+    ob8 = jnp.dtype(out_dtype).itemsize
+
+    def _vmem8(tm, tn, tk):
+        # int8 a/b tiles + f32 scale carriers, double-buffered, + f32/out
+        # blocks + the int32 acc scratch
+        return 2 * (tm * tk + tk * tn + tm * 128 * 4 + 8 * tn * 4) \
+            + 2 * tm * tn * ob8 + tm * tn * 4
+
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul_int8",
-        dtype_key=("int8",), caps=(1024, 1024, 1024), m_align=32)
+        dtype_key=("int8",), caps=(512, 1024, 1024), m_align=32,
+        vmem_bytes=_vmem8)
     # lane/sublane-aligned scale carriers (see _int8_kernel flush): the
     # replication costs m*512 + n*32 bytes of HBM — noise next to the
     # int8 operands — and keeps every VMEM block Mosaic-legal

@@ -37,6 +37,16 @@ def test_every_batch_label_has_a_sentinel(bp2):
     assert not missing, missing
 
 
+def test_every_guarded_config_has_a_sentinel(bp2, bench_src):
+    # bench.py's own _banked_in guard (rerun failures must not mask
+    # banked silicon results) only protects labels present in the map;
+    # the one dynamic label (gemm_16k_{r}x{c} tag) is covered by its
+    # single-chip 1x1 instantiation, which is what the driver runs
+    labels = set(re.findall(r'_guarded\(details,\s*"([^"]+)"', bench_src))
+    missing = sorted(labels - set(bp2.SENTINELS))
+    assert not missing, missing
+
+
 def test_every_batch_label_is_a_bench_config(bp2, bench_src):
     # labels are the second argument of _guarded(details, "label", ...);
     # the gemm_16k pair is f-string-tagged with the device-count grid

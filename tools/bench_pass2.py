@@ -108,39 +108,15 @@ def banked(label):
         d = json.loads(DETAILS.read_text())
     except Exception:
         return False
-    return f"{label}_error" not in d and _has_any_key(d, label)
+    return _banked_in(d, label)
 
 
-# one result key each config is guaranteed to merge on success — pinned
-# against bench.py's literals by tests/test_bench_pass2.py so the two
-# files cannot drift apart silently
-SENTINELS = {
-        "flash_attn_d128": "flash_attn_d128_tuned_block",
-        "flash_attn_tune": "flash_attn_tuned_block",
-        "flash_attn_full": "flash_attn_full_tuned_block",
-        "sp_train": "sp_train_step_s",
-        "transformer_train": "transformer_train_step_s",
-        "decode_kvcache": "decode_kvcache_tokens_per_s",
-        "int8_gemm": "int8_gemm_4096_s_per_iter",
-        "pallas_gemm": "pallas_gemm_4096_bf16_s_per_iter",
-        "pallas_gemm_tune": "pallas_gemm_tuned_block",
-        "gemm_16k_1x1": "gemm_16k_1x1_bf16pass_gflops",
-        "ring_hop": "ring_hop_fused_8k_bf16_s",
-        "ring_train": "ring_train_8k_bf16_s_per_iter",
-        "flash_train": "flash_train_8k_bf16_s_per_iter",
-        "stencil": "stencil_8192_step_s_per_iter",
-        "stencil_jnp": "stencil_8192_jnp_gcells_per_s",
-        "stencil_temporal": "stencil_8192_temporal_s_per_iter",
-        "broadcast_chain": "broadcast_chain_8192_s_per_iter",
-        "mapreduce": "mapreduce_1e8_s_per_iter",
-        "sort": "sort_1e7_s",
-    "gemm_f32_highest": "gemm_4096_f32_highest_gflops",
-    "gemm_16k_1x1_f32_highest": "gemm_16k_1x1_f32_highest_gflops",
-}
-
-
-def _has_any_key(d, label):
-    return SENTINELS.get(label) in d
+# one result key each config is guaranteed to merge on success — owned by
+# bench.py (single source of truth, shared with its own banked-result
+# guard); tests/test_bench_pass2.py pins every entry against bench.py's
+# key literals so the map cannot drift from the configs
+sys.path.insert(0, str(REPO))
+from bench import BANKED_SENTINELS as SENTINELS, _banked_in  # noqa: E402
 
 
 def run_label(label, budget, scale):
