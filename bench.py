@@ -762,7 +762,13 @@ def main():
 
         cands = [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
                  (2048, 512), (2048, 1024),
-                 (1024, 512, 2), (1024, 1024, 2), (2048, 1024, 2)]
+                 (1024, 512, 2), (1024, 1024, 2), (2048, 1024, 2),
+                 # round-5 second wave: the first silicon sweep showed
+                 # bk=1024 dominating bk=512 (113-117 vs 66-82 TFLOPS) and
+                 # bq=1024 beating 2048 — probe deeper K tiles and the
+                 # all-heads fold before settling at 0.596 MFU
+                 (512, 2048), (1024, 2048), (2048, 2048),
+                 (1024, 2048, 2), (1024, 1024, 4)]
         key = autotune.device_key_for(SQ, HQ, DQ, jnp.bfloat16(0).dtype, False)
         best, results = autotune.sweep("flash_attention", key, cands, timer, persist=True)
         autotune.save_default()
