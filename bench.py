@@ -213,6 +213,7 @@ BANKED_SENTINELS = {
     "flash_attn_tune": "flash_attn_tuned_block",
     "flash_attn_full": "flash_attn_full_tuned_block",
     "sp_train": "sp_train_step_s",
+    "sp_train_d128": "sp_train_d128_step_s",
     "transformer_train": "transformer_train_step_s",
     "decode_kvcache": "decode_kvcache_tokens_per_s",
     "int8_gemm": "int8_gemm_4096_s_per_iter",
@@ -1212,12 +1213,12 @@ def main():
     # KV-cache decode step.  On one chip the ring is 1-rank (hop-free)
     # — still the full composed program; multi-chip scaling is covered
     # by the dryrun/CPU-mesh legs until a multi-chip window exists.
-    def cfg_sp_train():
+    def _sp_train_entry(SH, prefix):
         from distributedarrays_tpu.models import sp_transformer as SPT
         from distributedarrays_tpu.parallel import collectives as C_
         p_ = len(jax.devices())
         mesh = C_.spmd_mesh(p_)
-        SV, SE, SH, SL = 8192, 1024, 16, 8
+        SV, SE, SL = 8192, 1024, 8
         SS = int(os.environ.get("DAT_BENCH_SP_SEQ", 8192))
         cfg = SPT.SPConfig(vocab=SV, dim=SE, heads=SH, layers=SL,
                            ffn_mult=4, max_seq=SS, dtype=jnp.bfloat16)
@@ -1256,17 +1257,31 @@ def main():
         flops = (6 * nparams * Bt * SS
                  + 3.5 * SL * (2 * 2 * SS * SS * Dh * SH) / 2 * Bt)
         out = {
-            "sp_train_step_s": t_step,
-            "sp_train_seq": SS,
-            "sp_train_tokens_per_s": Bt * SS / t_step,
-            "sp_train_params": nparams,
-            "sp_train_hop_blocks": [rcfg.block_q, rcfg.block_k,
-                                    rcfg.head_fold],
+            f"{prefix}_step_s": t_step,
+            f"{prefix}_seq": SS,
+            f"{prefix}_heads": SH,
+            f"{prefix}_head_dim": Dh,
+            f"{prefix}_tokens_per_s": Bt * SS / t_step,
+            f"{prefix}_params": nparams,
+            f"{prefix}_hop_blocks": [rcfg.block_q, rcfg.block_k,
+                                     rcfg.head_fold],
         }
-        _bank_tflops(out, "sp_train_model", flops / t_step / 1e12, peak)
+        _bank_tflops(out, f"{prefix}_model", flops / t_step / 1e12, peak)
         return out
 
+    def cfg_sp_train():
+        return _sp_train_entry(16, "sp_train")
+
+    def cfg_sp_train_d128():
+        # same parameter count (QKV/O shapes are head-count-invariant),
+        # head_dim 128: attention tiles span the full 128-lane MXU width
+        # instead of half of it — the d=64 flash ceiling is the measured
+        # bottleneck of the 16-head entry (flash d=64 0.31 vs d=128 0.60
+        # MFU on this chip)
+        return _sp_train_entry(8, "sp_train_d128")
+
     _guarded(details, "sp_train", cfg_sp_train, timeout_s=900)
+    _guarded(details, "sp_train_d128", cfg_sp_train_d128, timeout_s=900)
 
     def cfg_decode():
         from distributedarrays_tpu.models import transformer as T

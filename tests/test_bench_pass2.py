@@ -59,17 +59,35 @@ def test_every_batch_label_is_a_bench_config(bp2, bench_src):
 
 
 def test_every_sentinel_key_exists_in_bench(bp2, bench_src):
+    # BANKED_SENTINELS itself lives in bench.py, so every sentinel string
+    # trivially appears once in the source — strip the map before the
+    # literal checks or the test is vacuous (each key self-matches its
+    # own map entry and a renamed config key would never be caught)
+    src = re.sub(r"BANKED_SENTINELS = \{.*?\n\}", "", bench_src,
+                 flags=re.S)
+    assert "BANKED_SENTINELS = {" not in src, "sentinel map not stripped"
     for lbl, key in bp2.SENTINELS.items():
         if lbl.startswith("gemm_16k_"):
             # key is built as f"{tag}..." — check the suffix template
             suffix = key.removeprefix("gemm_16k_1x1")
-            assert f'"{{tag}}{suffix}"' in bench_src or \
-                f'f"{{tag}}{suffix}"' in bench_src, key
+            assert f'"{{tag}}{suffix}"' in src or \
+                f'f"{{tag}}{suffix}"' in src, key
             continue
         # _bank_tflops-generated keys end in _tflops/_mfu/_tops; the
         # sentinel must be the literal passed as the entry name + unit
         m = re.fullmatch(r"(.+)_(tflops|tops|mfu)", key)
-        if m and f'"{key}"' not in bench_src:
-            assert f'"{m.group(1)}"' in bench_src, key
+        if m and f'"{key}"' not in src:
+            assert f'"{m.group(1)}"' in src, key
             continue
-        assert f'"{key}"' in bench_src, key
+        if f'"{key}"' in src:
+            continue
+        # prefix-templated families (sp_train / sp_train_d128 share one
+        # parametrized config body): the key is built as
+        # f"{prefix}_suffix", and the label must be the prefix string
+        # actually passed at a call site — i.e. appear as the final
+        # string argument of some call (`..., "sp_train_d128")`), which
+        # neither the _guarded label position nor a map entry matches
+        suffix = key.removeprefix(lbl)
+        assert f'f"{{prefix}}{suffix}"' in src, key
+        assert re.search(r'\w+\([^()]*"%s"\)' % re.escape(lbl), src), \
+            f"{lbl} never passed as a prefix argument"

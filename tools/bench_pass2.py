@@ -38,6 +38,7 @@ BATCHES = [
     ("flash_attn_tune", 2100, 2.0),
     ("flash_attn_full", 2100, 2.0),
     ("sp_train", 1300, 1.3),
+    ("sp_train_d128", 1300, 1.3),
     ("transformer_train", 1300, 1.3),
     ("decode_kvcache", 1000, 1.3),
     ("int8_gemm", 1000, 1.3),
