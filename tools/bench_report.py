@@ -22,6 +22,11 @@ import sys
 from pathlib import Path
 
 
+# sweep dicts whose values are raw seconds (lower is better); every
+# other sweep banks TFLOPS (higher is better)
+_SECONDS_SWEEPS = {"ring_hop_sweep"}
+
+
 def _fmt(v, nd=2):
     return f"{v:,.{nd}f}" if isinstance(v, float) else str(v)
 
@@ -40,7 +45,9 @@ def render(path: str) -> str:
         out.append(f"- **note**: {d['_note']}")
 
     impossible = sorted(k for k in d if k.endswith("_IMPOSSIBLE_above_peak"))
-    errors = sorted(k for k in d if k.endswith("_error"))
+    reruns = sorted(k for k in d if k.endswith("_rerun_error"))
+    errors = sorted(k for k in d if k.endswith("_error")
+                    and not k.endswith("_rerun_error"))
     if impossible:
         out.append("\n## IMPOSSIBLE ENTRIES (measurement above chip peak "
                    "— do not publish)\n")
@@ -48,6 +55,9 @@ def render(path: str) -> str:
     if errors:
         out.append("\n## Configs that errored\n")
         out.extend(f"- `{k[:-6]}`: {str(d[k])[:120]}" for k in errors)
+    if reruns:
+        out.append("\n## Rerun failures (banked result above retained)\n")
+        out.extend(f"- `{k[:-12]}`: {str(d[k])[:120]}" for k in reruns)
 
     rows = []
     for k in sorted(d):
@@ -67,16 +77,22 @@ def render(path: str) -> str:
         elif k.endswith(("_gbps", "_gcells_per_s")):
             unit = "GB/s" if k.endswith("_gbps") else "Gcell/s"
             rows.append((k, f"{_fmt(v)} {unit}", "—"))
+        elif k.endswith("_tokens_per_s"):
+            rows.append((k, f"{_fmt(v)} tok/s", "—"))
         elif k.endswith(("_s", "_s_per_iter", "_latency_s")):
             rows.append((k, f"{_fmt(v, 6)} s", "—"))
         elif k.endswith(("_block", "_speedup", "_L", "_attempts")):
             rows.append((k, _fmt(v), "—"))
         elif isinstance(v, dict):
-            best = max(v.items(), key=lambda kv: kv[1]) \
-                if all(isinstance(x, (int, float)) for x in v.values()) \
-                else None
+            best = None
+            if v and all(isinstance(x, (int, float)) for x in v.values()):
+                # sweeps bank either TFLOPS (higher wins) or raw seconds
+                # (lower wins); direction is per-key, NOT guessed from
+                # magnitudes (CPU runs invert every magnitude heuristic)
+                pick = min if k in _SECONDS_SWEEPS else max
+                best = pick(v.items(), key=lambda kv: kv[1])
             rows.append((k, f"sweep of {len(v)}"
-                         + (f", best {best[0]} = {_fmt(best[1])}"
+                         + (f", best {best[0]} = {_fmt(best[1], 4)}"
                             if best else ""), "—"))
         else:
             rows.append((k, _fmt(v), "—"))
