@@ -409,7 +409,11 @@ def main():
     except Exception:
         prior = {}
     for lbl in _ONLY:
+        # a targeted rerun starts clean: stale failure markers from any
+        # earlier invocation (including _rerun_error next to a banked
+        # result) must not read as THIS run's outcome
         prior.pop(f"{lbl}_error", None)
+        prior.pop(f"{lbl}_rerun_error", None)
         prior.pop(f"{lbl}_orphan_running", None)
     for k in ("bench_only_unmatched_labels", "bench_only_known_labels"):
         prior.pop(k, None)

@@ -161,24 +161,30 @@ def main():
     return finish(st)
 
 
+def run_tpu_test_leg(st, tag="pass2"):
+    """The DAT_TEST_TPU=1 hardware pytest leg — the 13-test
+    Pallas-on-silicon validation.  Shared by pass-2 and pass-3 (the
+    state record must be identical whichever pass last had hardware)."""
+    log(f"{tag}: running DAT_TEST_TPU=1 pytest leg")
+    env = dict(os.environ, DAT_TEST_TPU="1")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_tpu_compiled.py", "-q", "-rs"],
+            cwd=REPO, capture_output=True, text=True,
+            timeout=2400, env=env)
+        st["tpu_tests_rc"] = r.returncode
+        log(f"{tag} tpu tests rc={r.returncode}: "
+            + r.stdout[-600:].replace("\n", " "))
+    except subprocess.TimeoutExpired:
+        st["tpu_tests_rc"] = "timeout"
+        log(f"{tag} tpu tests hard-timeout")
+    save_state(st)
+
+
 def finish(st):
-    # hardware pytest leg — the 13-test Pallas-on-silicon validation
     if st.get("tpu_tests_rc") != 0 and wait_for_tunnel():
-        log("running DAT_TEST_TPU=1 pytest leg")
-        env = dict(os.environ, DAT_TEST_TPU="1")
-        try:
-            r = subprocess.run(
-                [sys.executable, "-m", "pytest",
-                 "tests/test_tpu_compiled.py", "-q", "-rs"],
-                cwd=REPO, capture_output=True, text=True,
-                timeout=2400, env=env)
-            st["tpu_tests_rc"] = r.returncode
-            log(f"tpu tests rc={r.returncode}: "
-                + r.stdout[-600:].replace("\n", " "))
-        except subprocess.TimeoutExpired:
-            st["tpu_tests_rc"] = "timeout"
-            log("tpu tests hard-timeout")
-        save_state(st)
+        run_tpu_test_leg(st, tag="pass2")
     DONE.write_text(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     log("pass2 done")
 
