@@ -277,6 +277,13 @@ def _guarded(details, label, fn, timeout_s=420.0):
             details[f"{label}_error"] = "skipped (global bench deadline)"
             _save(details)
         return
+    # the label is about to actually execute: clear ITS stale failure
+    # markers (in memory only — no _save until an outcome exists) so
+    # whatever ends up in the table is attributable to this attempt.
+    # Labels this invocation never reaches keep their markers on disk.
+    for stale in (f"{label}_error", f"{label}_rerun_error",
+                  f"{label}_orphan_running"):
+        details.pop(stale, None)
     effective = min(timeout_s * _TSCALE, _remaining())
     finished, res, thread = _run_with_timeout(fn, effective)
     if finished and isinstance(res, Exception) and \
@@ -408,13 +415,10 @@ def main():
         prior = json.loads(cur.read_text()) if cur.exists() else {}
     except Exception:
         prior = {}
-    for lbl in _ONLY:
-        # a targeted rerun starts clean: stale failure markers from any
-        # earlier invocation (including _rerun_error next to a banked
-        # result) must not read as THIS run's outcome
-        prior.pop(f"{lbl}_error", None)
-        prior.pop(f"{lbl}_rerun_error", None)
-        prior.pop(f"{lbl}_orphan_running", None)
+    # NOTE: stale failure markers are cleared per-label inside _guarded,
+    # at the moment the label actually executes — clearing them here for
+    # every DAT_BENCH_ONLY label would erase recorded failure evidence
+    # for labels this invocation never reaches (killed mid-run, deadline)
     for k in ("bench_only_unmatched_labels", "bench_only_known_labels"):
         prior.pop(k, None)
     prior_prov = prior.pop("_provenance", None)

@@ -29,27 +29,34 @@ WORK = [
     ("sp_train_d128", 1300, 1.3, False),     # new flagship entry
 ]
 
-# pass-2 logs at least once per probe cycle (420 s sleep + <=180 s
-# probe); a log silent for 3x that is a dead or wedged pass-2
-STALE_LOG_S = 1800
+# pass-2's LONGEST legitimately silent stretch is a label subprocess in
+# flight (budget + 300 s kill-grace = up to 2700 s for the big sweeps,
+# 2400 s for the pytest leg) — the probe-cycle cadence (<= 600 s) only
+# holds while the tunnel is down.  65 min of silence means dead/wedged.
+STALE_LOG_S = 3900
 
 
 def pass2_active():
+    """Is pass-2 still working?  DONE marker wins; otherwise its log
+    heartbeat.  Pass-3 must not write to the shared log before or during
+    this wait (its own writes would read as pass-2 liveness) — startup
+    status goes to stdout instead."""
     if p2.DONE.exists():
         return False
     try:
-        age = time.time() - p2.LOG.stat().st_mtime
+        mtime = p2.LOG.stat().st_mtime
     except OSError:
         return False     # no log at all: nothing to wait for
-    return age < STALE_LOG_S
+    return (time.time() - mtime) < STALE_LOG_S
 
 
 def fresh_outcome_ok(label):
     """Did the MOST RECENT invocation of this label succeed?  bench.py's
-    targeted-rerun seeding clears the label's failure markers up front,
-    so any *_error/*_rerun_error present afterwards is THIS run's; for a
-    forced re-run of a banked label, banked() alone is vacuously true
-    and cannot distinguish a fresh failure (review round-5)."""
+    _guarded clears the label's failure markers at the moment the label
+    executes, so any *_error/*_rerun_error present afterwards is THIS
+    run's; for a forced re-run of a banked label, banked() alone is
+    vacuously true and cannot distinguish a fresh failure (review
+    round-5)."""
     try:
         d = json.loads(p2.DETAILS.read_text())
     except Exception:
@@ -67,13 +74,21 @@ def _prov_utc():
 
 
 def main():
-    p2.log("pass3 armed; waiting for pass2 to finish")
-    while pass2_active() and time.time() < p2.DEADLINE:
+    import os
+    wait_deadline = time.time() + float(
+        os.environ.get("DAT_PASS3_WAIT_HOURS", "10")) * 3600
+    print(f"pass3 armed; waiting for pass2 (wait deadline "
+          f"{(wait_deadline - time.time()) / 3600:.1f}h)", flush=True)
+    while pass2_active() and time.time() < wait_deadline:
         time.sleep(60)
-    if time.time() >= p2.DEADLINE:
-        p2.log("pass3: deadline before pass2 finished; nothing run")
+    if time.time() >= wait_deadline:
+        p2.log("pass3: wait deadline before pass2 finished; nothing run")
         DONE3.write_text(json.dumps({"ran": False, "reason": "deadline"}))
         return
+    # pass-2 may have consumed the whole shared p2.DEADLINE window
+    # (flaky tunnel — exactly when leftovers exist): give pass-3 its own
+    # work budget for wait_for_tunnel/run loops
+    p2.DEADLINE = max(p2.DEADLINE, time.time() + 2 * 3600)
     p2.log("pass3 start")
     for label, budget, scale, force in WORK:
         if not force and p2.banked(label):
