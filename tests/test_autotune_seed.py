@@ -9,6 +9,7 @@ precedence on collision.
 """
 
 import json
+import os
 
 from distributedarrays_tpu.utils import autotune
 
@@ -24,10 +25,26 @@ def test_seed_file_parses_and_is_device_fenced():
     assert isinstance(data, dict) and data
     for kernel, entries in data.items():
         for key in entries:
-            # device_key_for appends "<platform>|<device_kind>"
+            # device_key_for appends "<platform>|<device_kind>"; the
+            # shipped seed may hold HARDWARE winners only — a cpu/
+            # interpret-mode winner in the tracked file would be exactly
+            # the foreign-platform leakage the fence exists to stop
             assert len(key.split("|")) >= 2, (kernel, key)
             platform = key.split("|")[-2]
-            assert platform in ("tpu", "cpu", "gpu"), (kernel, key)
+            assert platform in ("tpu", "gpu", "axon"), (kernel, key)
+
+
+def test_seed_refresh_allowlist_matches_this_fence():
+    # tools/seed_refresh.py promotes live-cache entries into the seed;
+    # its hardware allowlist and this test's fence must be the same set
+    # or the tool can write a seed this suite rejects
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "seed_refresh", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "seed_refresh.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert set(mod._HW_PLATFORMS) == {"tpu", "gpu", "axon"}
 
 
 def test_seed_entries_visible_after_registry_reset(monkeypatch):
