@@ -1,0 +1,38 @@
+"""The one copy of the virtual-CPU-mesh bootstrap recipe.
+
+Shared by tests/conftest.py and examples/_setup.py — environment-critical
+hang-avoidance logic must not exist as hand-synced duplicates.  Call
+``force_cpu_mesh()`` BEFORE the first ``import jax`` in the process.
+
+Why each step exists (observed round 5):
+- ``JAX_PLATFORMS=cpu`` in the ENV, not just the config API: children
+  (multihost forks, example subprocesses) inherit it, and the axon shim
+  consults it during backend init.
+- Dropping the axon plugin site from ``sys.path`` AND children's
+  ``PYTHONPATH``: a WEDGED tunnel (connection alive but hung, unlike a
+  refused one) blocks jax backend discovery even in CPU mode — the
+  plugin dials the relay during backend init.
+- ``--xla_force_host_platform_device_count``: the 8-device virtual mesh,
+  the JAX analog of the reference's ``addprocs`` harness.
+- ``jax.config.update`` AFTER import: this image's sitecustomize pre-sets
+  ``jax_platforms="axon,cpu"`` at interpreter startup, which outranks
+  the env var for the current process.
+"""
+
+import os
+import sys
+
+
+def force_cpu_mesh(device_count: int = 8) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count"
+            f"={device_count}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")

@@ -13,10 +13,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 _platforms = os.environ.get("JAX_PLATFORMS", "")
 _has_accel = any(p and p != "cpu" for p in _platforms.split(","))
 if os.environ.get("EXAMPLES_FORCE_CPU") == "1" or not _has_accel:
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "") +
-            " --xla_force_host_platform_device_count=8").strip()
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    # the wedged-tunnel-safe CPU bootstrap lives in ONE place, shared
+    # with tests/conftest.py — see _cpu_harness.py for why each step
+    # exists
+    import _cpu_harness
+    _cpu_harness.force_cpu_mesh()

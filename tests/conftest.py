@@ -18,35 +18,21 @@ import os
 _ON_REAL = os.environ.get("DAT_TEST_TPU") == "1"
 
 if not _ON_REAL:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    # A WEDGED tunnel (connection alive but hung, unlike a refused one)
-    # blocks jax backend discovery even in CPU mode — the axon plugin on
-    # the import path dials the relay during plugin enumeration (observed
-    # round 5).  The CPU suite never needs that backend: drop the plugin
-    # site from this process AND from children's PYTHONPATH (multihost
-    # tests fork subprocesses that must not hang either).
+    # the full wedged-tunnel-safe CPU bootstrap lives in ONE place,
+    # shared with examples/_setup.py — see _cpu_harness.py for why each
+    # step exists
     import sys as _sys
-    _sys.path[:] = [p for p in _sys.path if ".axon_site" not in p]
-    os.environ["PYTHONPATH"] = os.pathsep.join(
-        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-        if p and ".axon_site" not in p)
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8").strip()
+    from pathlib import Path as _Path
+    _sys.path.insert(0, str(_Path(__file__).resolve().parents[1]))
+    import _cpu_harness
+    _cpu_harness.force_cpu_mesh()
 
 import gc
 
 import numpy as np
 import pytest
 
-import jax
-
-if not _ON_REAL:
-    # this image's sitecustomize pre-sets jax_platforms="axon,cpu" at
-    # interpreter startup, which outranks the env var — force CPU via the
-    # config API before any backend is initialized
-    jax.config.update("jax_platforms", "cpu")
+import jax  # noqa: F401  (config already forced by _cpu_harness)
 
 import distributedarrays_tpu as dat
 

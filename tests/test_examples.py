@@ -15,7 +15,7 @@ EXAMPLES = Path(__file__).resolve().parents[1] / "examples"
 # them); the big training demos are exercised by their own suites
 FAST = ["quickstart.py", "life.py", "spmd_ring.py", "kmeans_demo.py",
         "cg_poisson.py", "tp_overlap_demo.py", "sp_train_demo.py",
-        "spectral_poisson.py"]
+        "spectral_poisson.py", "grid_gemm_demo.py"]
 
 
 
