@@ -11,6 +11,9 @@ precedence on collision.
 import json
 import os
 
+_SEED_REFRESH_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "seed_refresh.py")
+
 from distributedarrays_tpu.utils import autotune
 
 
@@ -40,8 +43,7 @@ def test_seed_refresh_allowlist_matches_this_fence():
     # or the tool can write a seed this suite rejects
     import importlib.util
     spec = importlib.util.spec_from_file_location(
-        "seed_refresh", os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "tools", "seed_refresh.py"))
+        "seed_refresh", _SEED_REFRESH_TOOL)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert set(mod._HW_PLATFORMS) == {"tpu", "gpu", "axon"}
@@ -71,3 +73,56 @@ def test_live_cache_overrides_seed(monkeypatch, tmp_path):
     assert autotune.get(kernel, key) == [7, 7]
     autotune.clear()
     monkeypatch.setattr(autotune, "_LOADED_ENV", False)
+
+
+def test_seed_refresh_rc_contract(tmp_path):
+    # the tool's exit codes are a CI contract: 0 = current/merged,
+    # 1 = --dry-run found stale entries, 2 = unreadable input (must be
+    # a diagnostic, not a traceback)
+    import json as _json
+    import subprocess
+    import sys as _sys
+    tool = _SEED_REFRESH_TOOL
+
+    def run_in(workdir, *args):
+        # run a COPY of the tool from a sandbox repo root so the real
+        # AUTOTUNE_SEED.json is never touched
+        import shutil
+        tooldir = workdir / "tools"
+        tooldir.mkdir(exist_ok=True)
+        shutil.copyfile(tool, tooldir / "seed_refresh.py")
+        return subprocess.run(
+            [_sys.executable, str(tooldir / "seed_refresh.py"), *args],
+            capture_output=True, text=True, cwd=workdir)
+
+    # no cache at all -> rc 0
+    r = run_in(tmp_path)
+    assert r.returncode == 0 and "nothing to merge" in r.stdout
+
+    # corrupt cache -> rc 2 with a clean diagnostic
+    (tmp_path / "AUTOTUNE_CACHE.json").write_text("{truncated")
+    r = run_in(tmp_path)
+    assert r.returncode == 2 and "unreadable" in r.stdout
+    assert "Traceback" not in r.stderr
+
+    # stale seed + --dry-run -> rc 1 and no write
+    (tmp_path / "AUTOTUNE_CACHE.json").write_text(_json.dumps(
+        {"k": {"1|2|tpu|TPU v5 lite": [8, 8]}}))
+    r = run_in(tmp_path, "--dry-run")
+    assert r.returncode == 1 and not (tmp_path / "AUTOTUNE_SEED.json").exists()
+
+    # corrupt SEED next to a valid cache -> the other rc-2 branch
+    (tmp_path / "AUTOTUNE_SEED.json").write_text("{truncated")
+    r = run_in(tmp_path)
+    assert r.returncode == 2 and "unreadable" in r.stdout
+    assert "Traceback" not in r.stderr
+    (tmp_path / "AUTOTUNE_SEED.json").unlink()
+
+    # real merge -> rc 0, hardware entry written, cpu entry excluded
+    (tmp_path / "AUTOTUNE_CACHE.json").write_text(_json.dumps(
+        {"k": {"1|2|tpu|TPU v5 lite": [8, 8],
+               "1|2|cpu|cpu": [4, 4]}}))
+    r = run_in(tmp_path)
+    assert r.returncode == 0
+    seed = _json.loads((tmp_path / "AUTOTUNE_SEED.json").read_text())
+    assert seed == {"k": {"1|2|tpu|TPU v5 lite": [8, 8]}}
