@@ -1,15 +1,17 @@
 #!/usr/bin/env python
-"""Round-5 pass-3: the three configs pass-2 cannot pick up, then the
-hardware pytest leg if pass-2 never got it green.
+"""Round-5 pass-3: the full second chance after pass-2 ends.
 
-Pass-2 is a long-lived process: labels added to its BATCHES file after
-launch (sp_train_d128), attempts exhausted before a fix landed
-(int8_gemm's scoped-VMEM OOM — kernel caps fixed at 9ccd839), and
-banked-but-superseded sweeps (flash_attn_d128 gained second-wave arms
-at be48220) all need one more targeted invocation each.  This runner
-waits for pass-2 to finish (DONE marker, or its log going silent — the
-pass-2 loop logs every probe cycle, so a stale log means a dead or
-wedged process), then runs exactly those.
+Pass-2 is a long-lived process that can end with work undone two ways:
+labels it structurally cannot pick up (added to its BATCHES file after
+launch; attempts exhausted before a fix landed; banked-but-superseded
+sweeps needing a forced re-run), and labels it never reached because
+its deadline expired during a tunnel outage.  This runner waits for
+pass-2 to finish (DONE marker, or its log going silent — the pass-2
+loop logs every probe cycle, so a stale log means a dead or wedged
+process), then works the ENTIRE remaining queue: every still-unbanked
+pass-2 label in pass-2's own priority order, the forced
+flash_attn_d128 re-sweep last (it refines an existing number), and the
+hardware pytest leg if pass-2 never got it green.
 """
 
 import json
@@ -22,12 +24,21 @@ import bench_pass2 as p2  # noqa: E402  (reuses probe/run_label/log/leg)
 
 DONE3 = p2.REPO / "tools" / "bench_pass3.done"
 
-# (label, budget_s, timeout_scale, force_even_if_banked)
-WORK = [
-    ("flash_attn_d128", 2400, 3.0, True),    # re-sweep: 5 new arms
-    ("int8_gemm", 1000, 1.3, False),         # first run with fixed caps
-    ("sp_train_d128", 1300, 1.3, False),     # new flagship entry
-]
+def work_items():
+    """The forced flash_attn_d128 re-sweep (5 new arms landed after the
+    first sweep banked), then EVERY still-unbanked pass-2 label with its
+    pass-2 budget — pass-2 can exhaust its deadline while the tunnel is
+    down, and a window that opens after its DONE marker must still be
+    able to bank the whole remaining queue, not just the leftovers
+    pass-2 structurally could not run."""
+    items = []
+    for label, budget, scale in p2.BATCHES:
+        if label != "flash_attn_d128":
+            items.append((label, budget, scale, False))
+    # the re-sweep LAST: it refines a number that already exists
+    # (117.5 TFLOPS / 0.596 MFU); never-banked configs outrank it
+    items.append(("flash_attn_d128", 2400, 3.0, True))
+    return items
 
 # pass-2's LONGEST legitimately silent stretch is a label subprocess in
 # flight (budget + 300 s kill-grace = up to 2700 s for the big sweeps,
@@ -86,11 +97,17 @@ def main():
         DONE3.write_text(json.dumps({"ran": False, "reason": "deadline"}))
         return
     # pass-2 may have consumed the whole shared p2.DEADLINE window
-    # (flaky tunnel — exactly when leftovers exist): give pass-3 its own
-    # work budget for wait_for_tunnel/run loops
-    p2.DEADLINE = max(p2.DEADLINE, time.time() + 2 * 3600)
-    p2.log("pass3 start")
-    for label, budget, scale, force in WORK:
+    # (flaky tunnel — exactly when leftovers exist): give pass-3 a work
+    # budget sized from what actually remains (budget + kill-grace per
+    # still-pending item, one attempt each, 2h floor) so the tail of
+    # the queue is never silently starved by a fixed floor
+    pending = [(lbl, b) for lbl, b, _, force in work_items()
+               if force or not p2.banked(lbl)]
+    need = sum(b + 300 for _, b in pending)
+    p2.DEADLINE = max(p2.DEADLINE, time.time() + max(need, 2 * 3600))
+    p2.log(f"pass3 start: {len(pending)} pending, "
+           f"work window {need / 3600:.1f}h")
+    for label, budget, scale, force in work_items():
         if not force and p2.banked(label):
             p2.log(f"pass3 {label}: already banked, skipping")
             continue
