@@ -35,5 +35,6 @@ from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
 from .ops.sort import dsort
 from .ops.sparse import dnnz, ddata_bcoo
 from . import parallel
+from . import telemetry
 
 __version__ = "0.1.0"

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from . import core
 from . import layout as L
+from . import telemetry as _tm
 from .core import allowscalar, _scalar_indexing_allowed
 
 __all__ = [
@@ -120,6 +121,11 @@ def _filler(kind: str, dims: tuple, dtype, sharding):
 
 @functools.lru_cache(maxsize=None)
 def _resharder(sharding):
+    # the body runs only on an lru miss — i.e. once per distinct target
+    # sharding — which is exactly the "new program" signal the journal's
+    # jit category tracks
+    _tm.count("jit.builds", fn="resharder")
+    _tm.event("jit", "build", fn="resharder", to=str(sharding))
     return jax.jit(lambda x: x, out_shardings=sharding)
 
 
@@ -247,8 +253,12 @@ class DArray:
             psh = L.padded_sharding_for(flat_pids, grid, pdims)
             if tuple(data.shape) == pdims:
                 if getattr(data, "sharding", psh) != psh:
+                    _tm.record_comm("reshard", _tm.nbytes_of(data),
+                                    op="padded_relayout")
                     data = jax.device_put(data, psh)
             elif tuple(data.shape) == dims:
+                _tm.record_comm("reshard", _tm.nbytes_of(data),
+                                op="blocked_pad")
                 data = _blocked_pad_jit(_cuts_key(cuts), psh)(data)
             else:
                 raise ValueError(f"data shape {tuple(data.shape)} matches "
@@ -515,9 +525,14 @@ class DArray:
             # opaque non-addressable RuntimeError.  Route through the
             # symmetric multi-controller gather instead — legitimate
             # under SPMD discipline (every process executes the same
-            # program, so every process is inside this same call)
+            # program, so every process is inside this same call).
+            # (comm accounting happens inside gather_global — recording
+            # d2h here too would double-count every cross-host gather)
             from .parallel import multihost
             return multihost.gather_global(g)
+        if _tm.enabled():
+            _tm.record_comm("d2h", _tm.nbytes_of(g), op="gather",
+                            shape=list(self.dims))
         return jax.device_get(g)
 
     def _mutate(self, updater):
@@ -535,6 +550,9 @@ class DArray:
         if new_data.shape != tuple(self.dims):
             raise ValueError("rebind shape mismatch")
         if self._padded:
+            if _tm.enabled():
+                _tm.record_comm("reshard", _tm.nbytes_of(new_data),
+                                op="blocked_pad", shape=list(self.dims))
             self._data = _blocked_pad_jit(_cuts_key(self.cuts),
                                           self._psharding)(new_data)
             return
@@ -544,6 +562,9 @@ class DArray:
                 # device_put places them fine
                 new_data = jax.device_put(new_data, self._sharding)
             else:
+                if _tm.enabled():
+                    _tm.record_comm("reshard", _tm.nbytes_of(new_data),
+                                    op="rebind", shape=list(self.dims))
                 new_data = _resharder(self._sharding)(new_data)
         self._data = new_data
 
@@ -928,18 +949,36 @@ def _put_global(host, sharding) -> jax.Array:
             # same devices, new layout: ONE compiled identity program
             # (_resharder is lru_cached on the sharding — no per-call
             # retrace)
+            if _tm.enabled():
+                _tm.record_comm("reshard", _tm.nbytes_of(host),
+                                op="put_global", shape=list(host.shape))
             return _resharder(sharding)(host)
         # device sets differ (e.g. a reduction shrank the rank grid below
         # the process count): replicate over the SOURCE mesh — compiled,
         # every owning process participates — then fall through to the
         # host-scatter path with the local replica every process now holds
         from jax.sharding import NamedSharding, PartitionSpec
+        _tm.record_comm("replicate", _tm.nbytes_of(host),
+                        op="put_global", shape=list(host.shape))
         rep = _resharder(NamedSharding(
             host.sharding.mesh, PartitionSpec()))(host)
         host = np.asarray(rep.addressable_data(0))
     if getattr(sharding, "is_fully_addressable", True):
+        # moving an existing device array to a new layout is a reshard
+        # (a no-op placement moves nothing); placing host data is a
+        # host→device scatter
+        if _tm.enabled():
+            if not isinstance(host, jax.Array):
+                _tm.record_comm("h2d", _tm.nbytes_of(host),
+                                op="device_put", shape=list(np.shape(host)))
+            elif host.sharding != sharding:
+                _tm.record_comm("reshard", _tm.nbytes_of(host),
+                                op="device_put", shape=list(host.shape))
         return jax.device_put(host, sharding)
     arr = np.asarray(host)
+    if _tm.enabled():
+        _tm.record_comm("h2d", arr.nbytes, op="make_array_from_callback",
+                        shape=list(arr.shape))
     # explicit dtype: a process owning NO shard of this array (device-
     # subset layouts) cannot infer it from the callback
     return jax.make_array_from_callback(
@@ -1227,6 +1266,7 @@ def distribute(A, procs=None, dist=None, like: DArray | None = None) -> DArray:
     scatter that the reference implements with its DestinationSerializer
     (serialize.jl:45-87): each device receives only its own slice.
     """
+    _tm.count("op.distribute")
     if isinstance(A, DArray):
         A = A.garray
     elif isinstance(A, SubDArray):
@@ -1407,6 +1447,7 @@ def copyto_(dest, src) -> "DArray":
     """Copy ``src`` into ``dest`` in place (reference copyto!(dest::
     SubOrDArray, src), darray.jl:679-687: per-worker local copy of the
     aligned view — here one XLA reshard/copy)."""
+    _tm.count("op.copyto_")
     if isinstance(dest, SubDArray):
         key = dest.key
         parent = dest.parent

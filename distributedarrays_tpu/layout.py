@@ -38,6 +38,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import telemetry as _tm
+
 __all__ = [
     "defaultdist",
     "defaultdist_1d",
@@ -221,6 +223,9 @@ def mesh_for(pids: Sequence[int], chunks: Sequence[int]) -> Mesh:
             names = tuple(f"d{i}" for i in range(max(len(chunks), 1)))
             m = Mesh(devs, axis_names=names)
             _mesh_cache[key] = m
+            _tm.count("mesh.builds")
+            _tm.event("mesh", "build", grid=list(chunks),
+                      ranks=len(use))
         return m
 
 

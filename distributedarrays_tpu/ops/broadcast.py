@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import darray as D
+from .. import telemetry as _tm
 from ..darray import DArray, SubDArray, _wrap_global, distribute
 
 __all__ = ["dmap", "dmap_into", "djit", "broadcasted"]
@@ -46,6 +47,13 @@ __all__ = ["dmap", "dmap_into", "djit", "broadcasted"]
 # accumulate jit wrappers (and captured closures) forever
 @functools.lru_cache(maxsize=512)
 def _jitted(fn: Callable, out_sharding):
+    # body runs only on an lru miss: a fresh jit wrapper means the next
+    # call compiles — the journal's retrace signal for the eager-op path
+    # (a fresh lambda per call defeats this cache AND the XLA cache; the
+    # counter makes that pathology visible)
+    _tm.count("jit.builds", fn="elementwise")
+    _tm.event("jit", "build", fn=getattr(fn, "__name__", str(fn)),
+              once_key=f"jit:elementwise:{getattr(fn, '__name__', fn)!s}")
     if out_sharding is None:
         return jax.jit(fn)
     return jax.jit(fn, out_shardings=out_sharding)
@@ -98,6 +106,9 @@ def _replicate(r, mesh_sh, warn_key=None, warn_msg=None):
     if warn_key is not None:
         from ..utils.debug import warn_once
         warn_once(warn_key, warn_msg)
+    if _tm.enabled():
+        _tm.record_comm("replicate", _tm.nbytes_of(r),
+                        op="broadcast_align", journal=warn_key is not None)
     return jax.device_put(
         r, jax.sharding.NamedSharding(mesh_sh.mesh,
                                       jax.sharding.PartitionSpec()))

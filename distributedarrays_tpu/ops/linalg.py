@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import layout as L
+from .. import telemetry as _tm
 from ..darray import DArray, SubDArray, _wrap_global, distribute
 from .broadcast import _unwrap, elementwise
 
@@ -683,6 +684,21 @@ def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
     use_ab = not (alpha == 1.0 and beta == 0.0)
     if beta != 0.0 and C is None:
         raise ValueError("beta accumulation requires out=")
+    if _tm.enabled():
+        # estimated cross-chip volume of the block GEMM on an (r, c) result
+        # grid: every device assembles its A row panel and B column panel,
+        # so the total receive volume is ~bytes(A)*(c-1) + bytes(B)*(r-1)
+        # (0 on a single device) — the SUMMA communication volume both the
+        # ring and GSPMD paths approximate.  An estimate, not a wire count.
+        r = int(dist[0]) if dist else 1
+        c = int(dist[1]) if len(dist) > 1 else 1
+        a_bytes = int(np.prod(av_shape)) * np.dtype(A.dtype).itemsize
+        b_bytes = _tm.nbytes_of(bv)
+        _tm.count("op.matmul")
+        _tm.record_comm("collective",
+                        a_bytes * (c - 1) + b_bytes * (r - 1),
+                        op="matmul", grid=f"{r}x{c}",
+                        shape=[m, k, n])
     # plain-mode dispatch to the hand-owned schedules (VERDICT round-3
     # item 4), each behind the autotune registry with jnp.matmul + GSPMD
     # as the unconditional fallback: the overlapped ring for the 1-D TP

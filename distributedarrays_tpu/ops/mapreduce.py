@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import layout as L
+from .. import telemetry as _tm
 from ..darray import (DArray, SubDArray, _wrap_global, darray, distribute,
                       from_chunks)
 from .broadcast import _jitted, _unwrap, _align_devices, elementwise
@@ -126,6 +127,7 @@ def dmapreduce(f: Callable, op_name_or_fn, d, dims=None):
     (the compiled analog of the reference's two-phase local-then-partials
     reduce) with a host fold as the untraceable-op fallback.
     """
+    _tm.count("op.mapreduce")
     reducer = _REDUCERS.get(op_name_or_fn, op_name_or_fn) \
         if isinstance(op_name_or_fn, str) else op_name_or_fn
     if callable(reducer) and _is_binary_op(reducer):
@@ -534,6 +536,9 @@ def samedist(d: DArray, like: DArray) -> DArray:
         raise ValueError(f"dims mismatch: {d.dims} vs {like.dims}")
     from ..darray import _fresh
     g = d.garray
+    if _tm.enabled() and g.sharding != like.sharding:
+        _tm.record_comm("reshard", _tm.nbytes_of(g), op="samedist",
+                        shape=list(d.dims))
     return like.with_data(_fresh(jax.device_put(g, like.sharding), g))
 
 

@@ -35,7 +35,7 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 __all__ = ["pallas_matmul", "pallas_matmul_int8", "quantized_matmul",
-           "quantize_rows"]
+           "quantize_rows", "entry_valid_for_seed"]
 
 
 # Scoped-VMEM budget for a GEMM tile set: v5e enforces a 16 MiB limit on
@@ -46,8 +46,36 @@ __all__ = ["pallas_matmul", "pallas_matmul_int8", "quantized_matmul",
 _VMEM_LIMIT = int(15.5 * 2**20)
 
 
+def _vmem_parts_matmul(tm, tn, tk, ab, bb, ob):
+    """Scoped-VMEM estimate for a float GEMM tile set, by component.
+    The Pallas pipeline DOUBLE-BUFFERS the streamed input and output
+    blocks (the ``_x2`` entries); the f32 accumulator scratch is single.
+    ``ab``/``bb``/``ob`` are the operand/output itemsizes."""
+    return {
+        "a_blocks_x2": 2 * tm * tk * ab,
+        "b_blocks_x2": 2 * tk * tn * bb,
+        "out_blocks_x2": 2 * tm * tn * ob,
+        "acc_scratch_f32": tm * tn * 4,
+    }
+
+
+def _vmem_parts_int8(tm, tn, tk, ob):
+    """Scoped-VMEM estimate for the int8 GEMM tile set, by component:
+    int8 input blocks and the output blocks double-buffered, PLUS the
+    grid-constant f32 scale carriers — lane/sublane-aligned to (bm, 128)
+    and (8, bn), also double-buffered by the pipeline — plus the int32
+    accumulator scratch."""
+    return {
+        "a_blocks_x2": 2 * tm * tk,
+        "b_blocks_x2": 2 * tk * tn,
+        "scale_carriers_x2": 2 * (tm * 128 * 4 + 8 * tn * 4),
+        "out_blocks_x2": 2 * tm * tn * ob,
+        "acc_scratch_i32": tm * tn * 4,
+    }
+
+
 def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
-                   caps, m_align, vmem_bytes=None):
+                   caps, m_align, vmem_parts=None):
     """Shared block-resolution path for the GEMM kernels: explicit
     ``block`` > valid autotune-cache entry > auto heuristic (whole dim
     when under the cap, else largest power-of-two divisor).  A
@@ -55,16 +83,17 @@ def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
     heuristic, never break dispatch — validation includes the Mosaic
     alignment rules (last dim % 128, second-to-last % ``m_align``, or
     equal to the array dim) and, when the caller supplies a
-    ``vmem_bytes(bm, bn, bk)`` estimator, the scoped-VMEM budget; only
-    real TPUs enforce either, interpret mode runs any tiling."""
+    ``vmem_parts(bm, bn, bk) -> {component: bytes}`` estimator, the
+    scoped-VMEM budget; only real TPUs enforce either, interpret mode
+    runs any tiling."""
     def aligned(tm, tn, tk):
         return ((tm % m_align == 0 or tm == m)
                 and (tn % 128 == 0 or tn == n)
                 and (tk % 128 == 0 or tk == k))
 
     def vmem_ok(tm, tn, tk):
-        return (interpret or vmem_bytes is None
-                or vmem_bytes(tm, tn, tk) <= _VMEM_LIMIT)
+        return (interpret or vmem_parts is None
+                or sum(vmem_parts(tm, tn, tk).values()) <= _VMEM_LIMIT)
 
     if block is None:
         from ..utils import autotune
@@ -92,16 +121,80 @@ def _resolve_block(m, n, k, block, interpret, *, kernel, dtype_key,
         bm, bn, bk = block
         bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
         if not vmem_ok(bm, bn, bk):
-            # fail at dispatch with the budget, not deep in Mosaic with a
-            # scoped-vmem stack OOM (the silicon failure mode this guards)
+            # fail at dispatch with the budget AND the per-component
+            # breakdown, not deep in Mosaic with a scoped-vmem stack OOM
+            # (the silicon failure mode this guards).  A legitimate
+            # near-budget tiling rejection must be diagnosable: the
+            # estimate double-buffers the streamed input/output blocks
+            # and the grid-constant scale carriers (the _x2 components),
+            # which is easy to forget when sizing blocks by raw tile
+            # bytes.
+            parts = vmem_parts(bm, bn, bk)
+            total = sum(parts.values())
+            breakdown = ", ".join(f"{c}={v}" for c, v in parts.items())
             raise ValueError(
-                f"block {(bm, bn, bk)} needs ~{vmem_bytes(bm, bn, bk)} "
-                f"bytes of scoped VMEM (double-buffered tiles + scratch), "
-                f"over the {_VMEM_LIMIT} budget; pass a smaller block=")
+                f"block {(bm, bn, bk)} needs ~{total} bytes of scoped "
+                f"VMEM, over the {_VMEM_LIMIT} budget (headroom "
+                f"{total - _VMEM_LIMIT} over). Estimate components — "
+                f"the pipeline double-buffers input/output blocks and "
+                f"grid-constant scale carriers (the _x2 entries): "
+                f"{breakdown}. Pass a smaller block=.")
     if m % bm or n % bn or k % bk:
         raise ValueError(
             f"shapes ({m},{k})x({k},{n}) must divide block {(bm, bn, bk)}")
     return bm, bn, bk
+
+
+def entry_valid_for_seed(kernel: str, key: str, entry):
+    """Validity predicate for promoting an autotune-cache GEMM winner into
+    the tracked seed registry (tools/seed_refresh.py): the SAME checks
+    ``_resolve_block`` applies at dispatch — well-formed 3-tuple, shape
+    divisibility, Mosaic alignment (last dim % 128, M block % m_align),
+    and the per-kernel scoped-VMEM estimate — so a winner measured before
+    a VMEM-estimator fix can never ship as a dead entry that every later
+    dispatch silently rejects (ADVICE round-5).
+
+    Returns ``None`` for kernels this module does not own (no opinion),
+    else ``True``/``False``.  ``key`` is ``m|n|k|<dtypes...>|platform|
+    device_kind`` as built by ``autotune.device_key_for``.
+    """
+    if kernel not in ("pallas_matmul", "pallas_matmul_int8"):
+        return None
+    segs = str(key).split("|")
+    # device_key_for produces exactly this arity per kernel (m, n, k,
+    # dtype segs, platform, kind); anything else cannot match a lookup
+    # and must not ship
+    if len(segs) != (7 if kernel == "pallas_matmul" else 6):
+        return False
+    try:
+        m, n, k = (int(x) for x in segs[:3])
+    except ValueError:
+        return False
+    from ..utils.autotune import valid_ints
+    vals = valid_ints(entry, (3,))
+    if vals is None:
+        return False
+    bm, bn, bk = vals
+    if m % bm or n % bn or k % bk:
+        return False
+    if kernel == "pallas_matmul_int8":
+        m_align = 32
+        # dispatch-default f32 output — the layout quantized_matmul uses
+        parts = _vmem_parts_int8(bm, bn, bk, 4)
+    else:
+        m_align = 8
+        try:
+            ab = jnp.dtype(segs[3]).itemsize
+            bb = jnp.dtype(segs[4]).itemsize
+            ob = jnp.dtype(jnp.result_type(jnp.dtype(segs[3]),
+                                           jnp.dtype(segs[4]))).itemsize
+        except TypeError:
+            return False
+        parts = _vmem_parts_matmul(bm, bn, bk, ab, bb, ob)
+    aligned = ((bm % m_align == 0 or bm == m)
+               and (bn % 128 == 0 or bn == n)
+               and (bk % 128 == 0 or bk == k))
+    return aligned and sum(parts.values()) <= _VMEM_LIMIT
 
 
 def _pow2_divisor(dim: int, cap: int) -> int:
@@ -193,16 +286,12 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     ab, bb = jnp.dtype(a.dtype).itemsize, jnp.dtype(b.dtype).itemsize
     ob = jnp.dtype(out_dtype).itemsize
 
-    def _vmem(tm, tn, tk):
-        # double-buffered in/out blocks + the f32 acc scratch
-        return 2 * (tm * tk * ab + tk * tn * bb) + 2 * tm * tn * ob \
-            + tm * tn * 4
-
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul",
         dtype_key=(a.dtype, b.dtype),
         caps=(1024, 1024, 512) if two_byte else (512, 512, 512), m_align=8,
-        vmem_bytes=_vmem)
+        vmem_parts=lambda tm, tn, tk: _vmem_parts_matmul(
+            tm, tn, tk, ab, bb, ob))
     fn = _build(m, n, ka, bm, bn, bk, str(out_dtype), epilogue, interpret)
     return fn(a, b)
 
@@ -308,16 +397,10 @@ def pallas_matmul_int8(qa, qb, a_scale, b_scale,
     # ~9.7 MB with the same K-step arithmetic intensity
     ob8 = jnp.dtype(out_dtype).itemsize
 
-    def _vmem8(tm, tn, tk):
-        # int8 a/b tiles + f32 scale carriers, double-buffered, + f32/out
-        # blocks + the int32 acc scratch
-        return 2 * (tm * tk + tk * tn + tm * 128 * 4 + 8 * tn * 4) \
-            + 2 * tm * tn * ob8 + tm * tn * 4
-
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul_int8",
         dtype_key=("int8",), caps=(512, 1024, 1024), m_align=32,
-        vmem_bytes=_vmem8)
+        vmem_parts=lambda tm, tn, tk: _vmem_parts_int8(tm, tn, tk, ob8))
     # lane/sublane-aligned scale carriers (see _int8_kernel flush): the
     # replication costs m*512 + n*32 bytes of HBM — noise next to the
     # int8 operands — and keeps every VMEM block Mosaic-legal

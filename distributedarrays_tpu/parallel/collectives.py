@@ -36,11 +36,25 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import layout as L
+from .. import telemetry as _tm
 
 __all__ = [
     "spmd_mesh", "run_spmd", "pshift", "halo_exchange", "pbarrier",
     "pbcast", "pgather", "preduce", "pall_to_all", "axis_rank", "axis_size",
 ]
+
+
+def _rec(kind: str, x, axis: str, **fields) -> None:
+    """Trace-time communication accounting for the compiled collectives.
+
+    These helpers execute inside ``shard_map`` tracing, so the recording
+    happens ONCE PER TRACE (compilation), not per device step — flagged
+    ``traced=True`` in the journal.  ``x`` is the per-rank block; its
+    static shape/dtype give the per-rank payload estimate."""
+    if _tm.enabled():
+        _tm.record_comm(kind, _tm.nbytes_of(x), axis=axis, traced=True,
+                        once_key=f"collective:{kind}:{axis}:{fields}",
+                        **fields)
 
 
 def spmd_mesh(n: int | None = None, axis: str = "p") -> Mesh:
@@ -58,6 +72,10 @@ def run_spmd(f: Callable, mesh: Mesh, in_specs, out_specs,
     (spmd.jl:233-254): every rank runs the same ``f`` on its shard; inside,
     collectives from this module communicate over the mesh axes.
     """
+    _tm.count("op.run_spmd")
+    _tm.event("jit", "build", fn="run_spmd",
+              once_key=f"run_spmd:{getattr(f, '__name__', f)!s}:"
+                       f"{tuple(mesh.shape.items())}")
     return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=check_vma))
 
@@ -83,6 +101,7 @@ def pshift(x, axis: str, shift: int = 1, wrap: bool = True):
         perm = [(i, (i + shift) % n) for i in range(n)]
     else:
         perm = [(i, i + shift) for i in range(n) if 0 <= i + shift < n]
+    _rec("ppermute", x, axis, op="pshift", shift=shift)
     return lax.ppermute(x, axis, perm)
 
 
@@ -131,6 +150,7 @@ def pbarrier(axis: str):
     """Synchronization point: all ranks must reach it before any proceeds
     (reference barrier, spmd.jl:159-184).  In a compiled SPMD program this
     is a collective dependency — a psum of 1."""
+    _rec("psum", jnp.ones((), jnp.int32), axis, op="pbarrier")
     return lax.psum(jnp.ones((), jnp.int32), axis)
 
 
@@ -139,12 +159,14 @@ def pbcast(x, axis: str, root: int = 0):
     mask + all-reduce, which XLA lowers to an ICI broadcast."""
     me = lax.axis_index(axis)
     masked = jnp.where(me == root, x, jnp.zeros_like(x))
+    _rec("psum", x, axis, op="pbcast", root=root)
     return lax.psum(masked, axis)
 
 
 def pgather(x, axis: str, tiled: bool = False):
     """Concatenate every rank's block, pid-ordered (reference gather,
     spmd.jl:214-231) → ``lax.all_gather``."""
+    _rec("all_gather", x, axis, op="pgather")
     return lax.all_gather(x, axis, tiled=tiled)
 
 
@@ -155,6 +177,8 @@ _PREDUCERS = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
 def preduce(x, axis: str, op: str = "sum"):
     """All-reduce over a mesh axis (two-phase mapreduce analog,
     mapreduce.jl:29-35, but over ICI)."""
+    _rec("psum" if op in ("sum", "mean") else f"p{op}", x, axis,
+         op="preduce")
     return _PREDUCERS[op](x, axis)
 
 
@@ -162,5 +186,6 @@ def pall_to_all(x, axis: str, split_dim: int, concat_dim: int,
                 tiled: bool = True):
     """All-to-all repartition (the scatter phase of the reference's sample
     sort, sort.jl:24-55) → ``lax.all_to_all``."""
+    _rec("all_to_all", x, axis, op="pall_to_all")
     return lax.all_to_all(x, axis, split_axis=split_dim,
                           concat_axis=concat_dim, tiled=tiled)

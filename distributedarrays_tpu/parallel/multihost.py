@@ -24,6 +24,7 @@ import jax
 from jax.sharding import Mesh
 
 from .. import layout as L
+from .. import telemetry as _tm
 
 __all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
            "host_local_slice", "gather_global"]
@@ -44,9 +45,12 @@ def initialize(coordinator_address: str | None = None,
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
+        _tm.event("multihost", "initialize",
+                  num_processes=num_processes, process_id=process_id)
         return
     try:
         jax.distributed.initialize()
+        _tm.event("multihost", "initialize", auto=True)
     except ValueError as e:
         # Degrade to single-process mode ONLY for the "nothing configured"
         # signature: auto-detection found no cluster, so initialize() had
@@ -104,6 +108,10 @@ def gather_global(d) -> np.ndarray:
     arr = d.garray if hasattr(d, "garray") else d
     if jax.process_count() == 1:
         return np.asarray(arr)
+    # cross-host gather: every non-owning process receives the full array
+    # over DCN (replication program and/or host-level allgather)
+    _tm.record_comm("multihost_gather", _tm.nbytes_of(arr),
+                    op="gather_global", shape=list(np.shape(arr)))
     procs_of = sorted({dev.process_index for dev in arr.sharding.device_set})
     me = jax.process_index()
     if len(procs_of) > 1:

@@ -36,6 +36,7 @@ import numpy as np
 
 from .. import core
 from .. import layout as L
+from .. import telemetry as _tm
 
 __all__ = [
     "spmd", "sendto", "recvfrom", "recvfrom_any", "barrier", "bcast",
@@ -194,6 +195,15 @@ def context_local_storage() -> dict:
 def sendto(pid: int, data: Any, tag: Any = None):
     """Async send to ``pid`` (reference sendto, spmd.jl:145-147)."""
     ctx, rank = _current()
+    # per-send byte accounting (estimate: array payloads report nbytes,
+    # unsized Python objects report 0); journal dedup'd per direction so
+    # a chatty ring program cannot flood the journal.  enabled() guard:
+    # this is the SPMD hot path, disabled mode must not even build the
+    # key strings
+    if _tm.enabled():
+        _tm.record_comm("spmd_send", _tm.nbytes_of(data), op="sendto",
+                        once_key=f"spmd_send:{rank}->{pid}",
+                        src=rank, dst=pid)
     ctx.mailbox(pid).put(("sendto", rank, data, tag))
 
 
@@ -205,6 +215,7 @@ def recvfrom(pid: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
     m = ctx.mailbox(rank).take(
         lambda m: m[0] == "sendto" and m[1] == pid and m[3] == tag,
         ctx._failed, timeout)
+    _tm.count("spmd.recv")
     return m[2]
 
 
@@ -214,6 +225,7 @@ def recvfrom_any(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
     ctx, rank = _current()
     m = ctx.mailbox(rank).take(
         lambda m: m[0] == "sendto" and m[3] == tag, ctx._failed, timeout)
+    _tm.count("spmd.recv")
     return m[1], m[2]
 
 
@@ -226,6 +238,7 @@ def barrier(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
     """All-to-all barrier with double-barrier protection via per-rank
     generation counters (reference barrier, spmd.jl:159-184)."""
     ctx, rank = _current()
+    _tm.count("spmd.barrier")
     gen = ctx._barrier_gen[rank]
     ctx._barrier_gen[rank] = gen + 1
     btag = ("barrier", gen, tag)
@@ -250,6 +263,11 @@ def bcast(data: Any, root: int, tag: Any = None,
     _check_root(ctx, root)
     btag = ("bcast", tag)
     if rank == root:
+        if _tm.enabled():
+            _tm.record_comm("spmd_send",
+                            _tm.nbytes_of(data) * (len(ctx.pids) - 1),
+                            op="bcast", once_key=f"spmd_send:bcast:{root}",
+                            src=root)
         for p in ctx.pids:
             if p != root:
                 ctx.mailbox(p).put(("sendto", root, data, btag))
@@ -273,6 +291,9 @@ def scatter(x, root: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
             raise ValueError(
                 f"scatter: length {n} not divisible by {len(ctx.pids)} ranks")
         per = n // len(ctx.pids)
+        if _tm.enabled():
+            _tm.record_comm("spmd_send", _tm.nbytes_of(x), op="scatter",
+                            once_key=f"spmd_send:scatter:{root}", src=root)
         mine = None
         for i, p in enumerate(ctx.pids):
             part = x[i * per:(i + 1) * per]
@@ -295,6 +316,10 @@ def gather_spmd(x, root: int, tag: Any = None,
     _check_root(ctx, root)
     gtag = ("gather", tag)
     if rank != root:
+        if _tm.enabled():
+            _tm.record_comm("spmd_send", _tm.nbytes_of(x), op="gather",
+                            once_key=f"spmd_send:gather:{rank}->{root}",
+                            src=rank, dst=root)
         ctx.mailbox(root).put(("sendto", rank, x, gtag))
         return None
     out = {}
@@ -336,6 +361,9 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
     ctx = SPMDContext(pids) if implicit else context
     if pids is not None and not implicit and list(pids) != ctx.pids:
         raise ValueError("pids disagree with explicit context's pids")
+    _tm.count("spmd.runs", backend=backend)
+    _tm.event("spmd", "run", backend=backend, ranks=len(ctx.pids),
+              once_key=f"spmd:run:{backend}:{len(ctx.pids)}")
     if backend == "process":
         from .spmd_process import run_spmd_process
         try:

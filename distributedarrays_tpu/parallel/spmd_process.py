@@ -103,8 +103,16 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
     and storage snapshot are used, and each rank's storage dict is merged
     back after a successful run.  Returns ``{rank: result}`` or raises
     like the thread driver.
+
+    Telemetry note: counters mutated INSIDE forked children live in the
+    child's copy-on-write memory and die with it — rank-side sends on
+    this backend are therefore accounted at the parent level (one event
+    per run plus the result/leftover payload bytes shipped back), not
+    per-message.
     """
     import multiprocessing as mp
+
+    from .. import telemetry as _tm
 
     try:
         mpctx = mp.get_context("fork")
@@ -261,6 +269,13 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
         # failed (thread backend mutates ctx.store live; mirror that)
         for rank, st in stores.items():
             ctx.store[rank] = st
+
+    _tm.event("spmd", "process_run", ranks=len(ctx.pids),
+              ok=len(results), failed=len(errors),
+              once_key=f"spmd:process_run:{len(ctx.pids)}")
+    _tm.record_comm("spmd_process_result",
+                    sum(_tm.nbytes_of(v) for v in results.values()),
+                    op="run_spmd_process", journal=False)
 
     if errors:
         # prefer root-cause failures over structurally-marked peer aborts

@@ -1,0 +1,44 @@
+"""Framework-wide telemetry: counters, communication byte accounting, and
+a structured event journal.
+
+The observability layer the reference never had (SURVEY.md §5) and the
+`jax.profiler` wrappers in ``utils/profiling.py`` cannot provide: every
+reshard, eager transfer, traced collective, SPMD mailbox send, fallback
+hit, retrace, autotune lookup, and checkpoint phase in this framework
+reports here, so one process can answer "how many bytes did this workload
+move and how many reshards/retraces/fallbacks did it take?" without a
+profiler run.
+
+Quick use::
+
+    import distributedarrays_tpu as dat
+    from distributedarrays_tpu import telemetry
+
+    telemetry.configure("run.jsonl")      # optional JSONL journal
+    ...workload...
+    print(telemetry.report())             # nested dict
+    telemetry.dump("telemetry.json")      # JSON export
+
+    # offline: summarize a journal
+    #   python -m distributedarrays_tpu.telemetry run.jsonl
+
+Disable with ``DA_TPU_TELEMETRY=0`` (or :func:`disable`): every recording
+call becomes a boolean check and an immediate return, no journal file is
+ever created, and :func:`report` stays empty.
+
+Metric catalog and the journal schema: ``docs/telemetry.md``.
+"""
+
+from .core import (enabled, enable, disable, configure, reset, count,
+                   set_gauge, observe, event, record_comm, counter_value,
+                   gauge_value, comm_bytes, events, journal_path, nbytes_of,
+                   report, dump)
+from .summarize import read_journal, summarize, format_summary
+
+__all__ = [
+    "enabled", "enable", "disable", "configure", "reset",
+    "count", "set_gauge", "observe", "event", "record_comm",
+    "counter_value", "gauge_value", "comm_bytes", "events",
+    "journal_path", "nbytes_of", "report", "dump",
+    "read_journal", "summarize", "format_summary",
+]

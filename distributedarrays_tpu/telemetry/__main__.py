@@ -1,0 +1,48 @@
+"""CLI: summarize a telemetry journal (JSONL) or report export (JSON).
+
+Usage::
+
+    python -m distributedarrays_tpu.telemetry JOURNAL.jsonl [--json]
+
+Prints event counts by category, communication bytes by kind, and the
+journal's time span.  ``--json`` emits the summary as JSON instead of the
+text table.  The summarizer itself (``telemetry/summarize.py``) is pure
+stdlib; running it via ``-m`` imports the parent package (JAX present),
+so on a JAX-less machine import ``summarize.py`` directly instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .summarize import read_journal, summarize, format_summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributedarrays_tpu.telemetry",
+        description="Summarize a telemetry journal (JSONL).")
+    ap.add_argument("journal", help="path to the JSONL journal "
+                                    "(or '-' for stdin)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON")
+    args = ap.parse_args(argv)
+    try:
+        events = read_journal(sys.stdin if args.journal == "-"
+                              else args.journal)
+    except OSError as e:
+        print(f"cannot read journal: {e}", file=sys.stderr)
+        return 2
+    s = summarize(events)
+    if args.json:
+        json.dump(s, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        format_summary(s, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,382 @@
+"""Telemetry core: the process-wide metrics registry and event journal.
+
+The reference package has no observability at all (SURVEY.md §5:
+"Tracing/profiling: none — only commented-out println debugging");
+``utils/profiling.py`` wraps the platform profiler but cannot answer
+framework-level questions — how many bytes did this workload move, how
+many reshards/retraces/fallbacks did it take?  This module is the answer:
+
+- **metrics registry** — process-wide, thread-safe counters, gauges, and
+  summary histograms, keyed by name plus optional labels.  When telemetry
+  is disabled (``DA_TPU_TELEMETRY=0`` or :func:`disable`) every recording
+  call is a single boolean check and an immediate return — no locks, no
+  allocation — so instrumentation can stay in hot paths unconditionally.
+- **communication accounting** — :func:`record_comm` is the one funnel
+  every instrumented communication site goes through (reshards, eager
+  transfers, traced collectives, SPMD mailbox sends, multihost gathers).
+  It feeds per-kind op/byte counters and the journal.
+- **event journal** — an append-only, bounded in-memory buffer of
+  structured events with *monotonic* timestamps, mirrored to an
+  append-only JSONL file when a journal path is configured
+  (``DA_TPU_TELEMETRY_JOURNAL`` or :func:`configure`).  The file is
+  created lazily on the first event, so a disabled process never touches
+  the filesystem.
+
+Byte numbers are documented **estimates** (payload sizes at the recording
+site), not link-level measurements; traced collectives record at *trace*
+time (once per compilation), flagged with ``traced=True``.
+
+This module deliberately imports nothing from the rest of the package
+(stdlib only), so any layer — layout, darray, ops, parallel, utils — can
+import it without cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "enabled", "enable", "disable", "configure", "reset",
+    "count", "set_gauge", "observe", "event", "record_comm",
+    "counter_value", "gauge_value", "comm_bytes", "events",
+    "journal_path", "nbytes_of", "report", "dump",
+]
+
+_FALSY = ("0", "false", "off", "no")
+
+
+def _env_enabled() -> bool:
+    v = os.environ.get("DA_TPU_TELEMETRY")
+    return v is None or v.strip().lower() not in _FALSY
+
+
+_LOCK = threading.RLock()
+_ENABLED: bool = _env_enabled()
+
+_counters: dict[str, float] = {}
+_gauges: dict[str, float] = {}
+_hists: dict[str, dict] = {}
+# comm accounting: kind -> {"ops": n, "bytes": b}
+_comm: dict[str, dict] = {}
+
+_EVENT_BUFFER_MAX = 8192
+_events: deque = deque(maxlen=_EVENT_BUFFER_MAX)
+_events_total = 0          # includes events evicted from the buffer
+_once_keys: set = set()    # journal dedup for high-frequency sites
+
+_journal_path: str | None = os.environ.get("DA_TPU_TELEMETRY_JOURNAL") or None
+_journal_file = None       # lazily opened append handle
+
+# one monotonic origin per process so every event timestamp is comparable
+_T0 = time.monotonic()
+
+
+def _key(name: str, labels: dict) -> str:
+    """Canonical metric key: ``name`` or ``name{k=v,...}`` (sorted)."""
+    if not labels:
+        return name
+    return name + "{" + ",".join(
+        f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+
+
+# ---------------------------------------------------------------------------
+# enable / disable / configure
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    """Whether telemetry is recording (env ``DA_TPU_TELEMETRY``, default
+    on; overridable at runtime with :func:`enable` / :func:`disable`)."""
+    return _ENABLED
+
+
+def enable() -> None:
+    global _ENABLED
+    with _LOCK:
+        _ENABLED = True
+
+
+def disable() -> None:
+    """Stop recording.  Already-recorded state stays queryable; the
+    journal file handle (if open) is closed."""
+    global _ENABLED
+    with _LOCK:
+        _ENABLED = False
+        _close_journal_locked()
+
+
+def configure(journal_path: str | None) -> None:
+    """Set (or clear, with ``None``) the JSONL journal path.  The file is
+    opened lazily on the next recorded event, in append mode."""
+    global _journal_path
+    with _LOCK:
+        _close_journal_locked()
+        _journal_path = journal_path
+
+
+def journal_path() -> str | None:
+    return _journal_path
+
+
+def reset() -> None:
+    """Clear every metric, the event buffer, and journal dedup state.
+    The enabled flag and the configured journal path are kept; an open
+    journal file handle is closed (the file itself is left in place)."""
+    global _events_total
+    with _LOCK:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _comm.clear()
+        _events.clear()
+        _once_keys.clear()
+        _events_total = 0
+        _close_journal_locked()
+
+
+def _close_journal_locked() -> None:
+    global _journal_file
+    if _journal_file is not None:
+        try:
+            _journal_file.close()
+        except Exception:
+            pass
+        _journal_file = None
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def count(name: str, n: float = 1, **labels) -> None:
+    """Increment counter ``name`` (with optional labels) by ``n``."""
+    if not _ENABLED:
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        _counters[k] = _counters.get(k, 0) + n
+
+
+def set_gauge(name: str, value: float, **labels) -> None:
+    """Set gauge ``name`` to ``value``."""
+    if not _ENABLED:
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        _gauges[k] = value
+
+
+def observe(name: str, value: float, **labels) -> None:
+    """Record ``value`` into summary histogram ``name`` (count / total /
+    min / max; mean derived at report time)."""
+    if not _ENABLED:
+        return
+    k = _key(name, labels)
+    with _LOCK:
+        h = _hists.get(k)
+        if h is None:
+            _hists[k] = {"count": 1, "total": value,
+                         "min": value, "max": value}
+        else:
+            h["count"] += 1
+            h["total"] += value
+            if value < h["min"]:
+                h["min"] = value
+            if value > h["max"]:
+                h["max"] = value
+
+
+def counter_value(name: str, **labels) -> float:
+    with _LOCK:
+        return _counters.get(_key(name, labels), 0)
+
+
+def gauge_value(name: str, default=None, **labels):
+    with _LOCK:
+        return _gauges.get(_key(name, labels), default)
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+
+def event(category: str, name: str | None = None, *,
+          once_key: str | None = None, **fields) -> None:
+    """Append a structured event to the journal.
+
+    ``t`` is seconds since the process's telemetry origin (monotonic —
+    safe to order and subtract); ``wall`` is the epoch time for humans.
+    ``once_key`` dedups high-frequency sites: only the FIRST event with a
+    given key is journaled (counters still see every occurrence)."""
+    if not _ENABLED:
+        return
+    global _events_total
+    with _LOCK:
+        if once_key is not None:
+            if once_key in _once_keys:
+                return
+            _once_keys.add(once_key)
+        rec = {"seq": _events_total,
+               "t": round(time.monotonic() - _T0, 6),
+               "wall": round(time.time(), 3),
+               "cat": category}
+        if name is not None:
+            rec["name"] = name
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        _events_total += 1
+        _events.append(rec)
+        _write_journal_locked(rec)
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return str(v)
+
+
+def _write_journal_locked(rec: dict) -> None:
+    global _journal_file
+    if _journal_path is None:
+        return
+    try:
+        if _journal_file is None:
+            parent = os.path.dirname(_journal_path)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            _journal_file = open(_journal_path, "a")
+        _journal_file.write(json.dumps(rec) + "\n")
+        _journal_file.flush()
+    except OSError:
+        # telemetry must never take down the workload it observes
+        _journal_file = None
+
+
+def events(category: str | None = None) -> list[dict]:
+    """Snapshot of the buffered events (most recent ``_EVENT_BUFFER_MAX``),
+    optionally filtered by category."""
+    with _LOCK:
+        evs = list(_events)
+    if category is None:
+        return evs
+    return [e for e in evs if e.get("cat") == category]
+
+
+# ---------------------------------------------------------------------------
+# communication accounting
+# ---------------------------------------------------------------------------
+
+
+def nbytes_of(x) -> int:
+    """Best-effort payload size in bytes: works on numpy/jax arrays AND
+    on tracers inside jit/shard_map (shape/dtype are static), on
+    bytes-like payloads, and degrades to 0 for unsized objects."""
+    try:
+        nb = getattr(x, "nbytes", None)
+        if isinstance(nb, (int, float)):
+            return int(nb)
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            n = 1
+            for s in shape:
+                n *= int(s)
+            import numpy as _np
+            return n * _np.dtype(dtype).itemsize
+        if isinstance(x, (bytes, bytearray, memoryview)):
+            return len(x)
+    except Exception:
+        pass
+    return 0
+
+
+def record_comm(kind: str, nbytes: int, *, axis=None, op: str | None = None,
+                journal: bool = True, once_key: str | None = None,
+                **fields) -> None:
+    """Account one communication: ``kind`` (reshard / h2d / d2h /
+    collective / replicate / spmd_send / multihost_gather / ...),
+    estimated payload ``nbytes``, optional mesh ``axis`` and originating
+    ``op``.  Feeds ``comm.ops``/``comm.bytes`` per kind and (unless
+    ``journal=False``) one journal event under category ``"comm"``."""
+    if not _ENABLED:
+        return
+    nbytes = int(nbytes)
+    with _LOCK:
+        c = _comm.get(kind)
+        if c is None:
+            _comm[kind] = {"ops": 1, "bytes": nbytes}
+        else:
+            c["ops"] += 1
+            c["bytes"] += nbytes
+    if journal:
+        ev = dict(fields)
+        if axis is not None:
+            ev["axis"] = axis
+        if op is not None:
+            ev["op"] = op
+        event("comm", kind, once_key=once_key, bytes=nbytes, **ev)
+
+
+def comm_bytes(kind: str | None = None) -> int:
+    """Total estimated bytes moved (optionally for one kind)."""
+    with _LOCK:
+        if kind is not None:
+            c = _comm.get(kind)
+            return int(c["bytes"]) if c else 0
+        return int(sum(c["bytes"] for c in _comm.values()))
+
+
+# ---------------------------------------------------------------------------
+# reporting
+# ---------------------------------------------------------------------------
+
+
+def report() -> dict:
+    """Nested snapshot of everything recorded so far."""
+    with _LOCK:
+        by_cat: dict[str, int] = {}
+        for e in _events:
+            by_cat[e["cat"]] = by_cat.get(e["cat"], 0) + 1
+        return {
+            "enabled": _ENABLED,
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+            "histograms": {
+                k: {**h, "mean": h["total"] / h["count"]}
+                for k, h in _hists.items()
+            },
+            "comm": {
+                "total_bytes": int(sum(c["bytes"] for c in _comm.values())),
+                "total_ops": int(sum(c["ops"] for c in _comm.values())),
+                "by_kind": {k: dict(v) for k, v in _comm.items()},
+            },
+            "events": {
+                "recorded": _events_total,
+                "buffered": len(_events),
+                "by_category": by_cat,
+                "journal_path": _journal_path,
+            },
+        }
+
+
+def dump(path: str) -> str:
+    """Write :func:`report` as indented JSON to ``path``; returns the
+    path.  Atomic (tmp + replace), same discipline as autotune.save."""
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report(), f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,42 @@
+"""Pytest fixture for telemetry-aware tests.
+
+Import into a ``conftest.py`` (or straight into a test module)::
+
+    from distributedarrays_tpu.telemetry.fixtures import telemetry_capture
+
+``telemetry_capture`` gives the test a clean, ENABLED telemetry state
+with a tmp-dir journal, and restores the process's prior state (enabled
+flag + journal path) afterwards — so telemetry tests cannot leak
+configuration into the rest of the suite, and the rest of the suite
+cannot pollute a telemetry assertion.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from . import core
+
+
+@pytest.fixture
+def telemetry_capture(tmp_path):
+    """Clean enabled telemetry with a journal at ``tmp_path/journal.jsonl``.
+
+    Yields the ``telemetry`` module facade; the journal path is
+    ``telemetry.journal_path()``.
+    """
+    prev_enabled = core.enabled()
+    prev_path = core.journal_path()
+    core.reset()
+    core.configure(str(tmp_path / "journal.jsonl"))
+    core.enable()
+    try:
+        from distributedarrays_tpu import telemetry
+        yield telemetry
+    finally:
+        core.reset()
+        core.configure(prev_path)
+        if prev_enabled:
+            core.enable()
+        else:
+            core.disable()

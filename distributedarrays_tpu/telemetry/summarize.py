@@ -1,0 +1,108 @@
+"""Journal summarization: turn a JSONL event journal into a compact
+human/machine summary.
+
+Shared by the CLI (``python -m distributedarrays_tpu.telemetry``) and by
+tests; pure stdlib so it can run on a machine without JAX (e.g. pulling a
+journal off a pod worker and summarizing it on a laptop).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, TextIO
+
+__all__ = ["read_journal", "summarize", "format_summary"]
+
+
+def read_journal(path_or_file) -> list[dict]:
+    """Parse a JSONL journal.  Malformed lines are skipped and counted
+    (a process killed mid-write leaves a torn final line; that must not
+    make the whole journal unreadable)."""
+    if hasattr(path_or_file, "read"):
+        lines: Iterable[str] = path_or_file
+    else:
+        with open(path_or_file) as f:
+            lines = f.readlines()
+    events, skipped = [], 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            skipped += 1
+            continue
+        if isinstance(ev, dict):
+            events.append(ev)
+        else:
+            skipped += 1
+    if skipped:
+        events.append({"cat": "_journal", "name": "malformed_lines",
+                       "count": skipped})
+    return events
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate a journal event list into the summary dict the CLI
+    prints: counts by category and by (category, name), communication
+    bytes/ops by kind, and the monotonic time span covered."""
+    by_cat: dict[str, int] = {}
+    by_name: dict[str, int] = {}
+    comm: dict[str, dict] = {}
+    tmin = tmax = None
+    for e in events:
+        cat = str(e.get("cat", "?"))
+        by_cat[cat] = by_cat.get(cat, 0) + 1
+        name = e.get("name")
+        if name is not None:
+            k = f"{cat}/{name}"
+            by_name[k] = by_name.get(k, 0) + 1
+        if cat == "comm":
+            kind = str(name)
+            c = comm.setdefault(kind, {"ops": 0, "bytes": 0})
+            c["ops"] += 1
+            c["bytes"] += int(e.get("bytes", 0) or 0)
+        t = e.get("t")
+        if isinstance(t, (int, float)):
+            tmin = t if tmin is None else min(tmin, t)
+            tmax = t if tmax is None else max(tmax, t)
+    return {
+        "events": len(events),
+        "span_s": round(tmax - tmin, 6) if tmin is not None else 0.0,
+        "by_category": dict(sorted(by_cat.items())),
+        "by_name": dict(sorted(by_name.items())),
+        "comm": {
+            "total_bytes": sum(c["bytes"] for c in comm.values()),
+            "total_ops": sum(c["ops"] for c in comm.values()),
+            "by_kind": dict(sorted(comm.items())),
+        },
+    }
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024
+    return f"{n} B"  # pragma: no cover
+
+
+def format_summary(summary: dict, out: TextIO) -> None:
+    """Render :func:`summarize`'s dict as an aligned text table."""
+    out.write(f"events: {summary['events']}  "
+              f"(span {summary['span_s']:.3f}s)\n")
+    out.write("\nby category:\n")
+    for cat, n in summary["by_category"].items():
+        out.write(f"  {cat:<16} {n}\n")
+    comm = summary["comm"]
+    out.write(f"\ncommunication (estimated): "
+              f"{_fmt_bytes(comm['total_bytes'])} over "
+              f"{comm['total_ops']} ops\n")
+    for kind, c in comm["by_kind"].items():
+        out.write(f"  {kind:<20} {c['ops']:>6} ops  "
+                  f"{_fmt_bytes(c['bytes'])}\n")
+    out.write("\ntop events:\n")
+    top = sorted(summary["by_name"].items(), key=lambda kv: -kv[1])[:20]
+    for name, n in top:
+        out.write(f"  {name:<40} {n}\n")

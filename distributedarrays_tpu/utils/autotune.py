@@ -25,6 +25,8 @@ import os
 import threading
 from typing import Any, Callable, Iterable, Mapping
 
+from .. import telemetry as _tm
+
 __all__ = ["get", "record", "sweep", "save", "load", "clear", "key_for",
            "device_key_for", "valid_ints",
            "default_cache_path", "save_default", "seed_path"]
@@ -126,11 +128,25 @@ def _maybe_load_env():
             pass  # a corrupt cache must never break kernel dispatch
 
 
+_MISS = object()
+
+
 def get(kernel: str, key: str, default=None):
-    """Tuned config for ``(kernel, key)``, or ``default``."""
+    """Tuned config for ``(kernel, key)``, or ``default``.
+
+    Every lookup is counted (telemetry ``autotune.hit`` / ``autotune.miss``
+    per kernel); the first miss per (kernel, key) is journaled, so a
+    workload silently dispatching on heuristic defaults is queryable."""
     with _LOCK:
         _maybe_load_env()
-        return _REGISTRY.get(kernel, {}).get(key, default)
+        entry = _REGISTRY.get(kernel, {}).get(key, _MISS)
+    if entry is _MISS:
+        _tm.count("autotune.miss", kernel=kernel)
+        _tm.event("autotune", "miss", kernel=kernel, key=key,
+                  once_key=f"autotune:miss:{kernel}:{key}")
+        return default
+    _tm.count("autotune.hit", kernel=kernel)
+    return entry
 
 
 def record(kernel: str, key: str, config) -> None:
@@ -201,4 +217,8 @@ def sweep(kernel: str, key: str, candidates: Iterable,
     if not results:
         raise last_exc if last_exc is not None else \
             ValueError("sweep got no candidates")
+    _tm.count("autotune.sweeps", kernel=kernel)
+    _tm.event("autotune", "sweep", kernel=kernel, key=key,
+              candidates=len(results), best=best,
+              best_s=results[best])
     return best, results

@@ -37,6 +37,7 @@ import numpy as np
 
 import jax
 
+from .. import telemetry as _tm
 from ..darray import DArray, DData, distribute
 
 __all__ = ["save", "load", "CheckpointManager"]
@@ -178,9 +179,14 @@ def save(path: str | os.PathLike, tree: Any, store: str = "npz") -> None:
     if store not in ("npz", "orbax"):
         # validate before any side effect (no stray directories/encodes)
         raise ValueError(f"unknown store {store!r} (use 'npz' or 'orbax')")
+    _tm.event("checkpoint", "save_start", path=str(path), store=store)
     arrays: dict[str, np.ndarray] = {}
     meta = _encode(tree, arrays)
     _write_store(Path(path), meta, arrays, store)
+    _tm.count("checkpoint.saves")
+    _tm.event("checkpoint", "save_end", path=str(path), store=store,
+              arrays=len(arrays),
+              bytes=int(sum(a.nbytes for a in arrays.values())))
 
 
 def load(path: str | os.PathLike) -> Any:
@@ -188,6 +194,7 @@ def load(path: str | os.PathLike) -> Any:
     their saved chunk grids (default relayout with a warning when fewer
     devices are available than at save time)."""
     path = Path(path)
+    _tm.event("checkpoint", "restore_start", path=str(path))
     meta_doc = json.loads((path / _META).read_text())
     # positive new-format detection: the sentinel key can never be produced
     # by _encode (user dicts containing it are item-pair encoded)
@@ -205,7 +212,12 @@ def load(path: str | os.PathLike) -> Any:
     else:
         with np.load(path / _ARRS) as z:
             arrays = {k: z[k] for k in z.files}
-    return _decode(meta, arrays)
+    out = _decode(meta, arrays)
+    _tm.count("checkpoint.restores")
+    _tm.event("checkpoint", "restore_end", path=str(path), store=store,
+              arrays=len(arrays),
+              bytes=int(sum(a.nbytes for a in arrays.values())))
+    return out
 
 
 def _write_store(path: Path, meta, arrays, store: str) -> None:
@@ -322,6 +334,12 @@ class CheckpointManager:
             shutil.rmtree(tmp)
         _write_store(tmp, meta, arrays, store)
         os.replace(tmp, final)
+        # event from the background save thread — the journal is
+        # thread-safe, and the publish time is the phase worth seeing
+        _tm.count("checkpoint.saves")
+        _tm.event("checkpoint", "publish", step=step, store=store,
+                  arrays=len(arrays),
+                  bytes=int(sum(a.nbytes for a in arrays.values())))
         self._rotate()
 
     def _rotate(self) -> None:

@@ -23,6 +23,7 @@ import numpy as np
 
 from .. import core
 from .. import layout as L
+from .. import telemetry
 from ..darray import DArray
 
 __all__ = ["validate", "check_all", "warn_once"]
@@ -35,11 +36,19 @@ def warn_once(key: str, msg: str, stacklevel: int = 3) -> None:
     """Emit ``msg`` as a RuntimeWarning the FIRST time ``key`` is seen in
     this process.  Used by ops that take a documented fallback path (e.g.
     shard_map → host loop) so the degradation is visible exactly once
-    instead of silently eating performance (VERDICT round-2 item 7)."""
+    instead of silently eating performance (VERDICT round-2 item 7).
+
+    Every call is additionally COUNTED (telemetry ``fallback.hits`` with
+    the site key as a label) and the first occurrence per key is
+    journaled under category ``"fallback"`` — so degradations are
+    queryable after the fact (``telemetry.report()``), not just visible
+    once on stderr."""
+    telemetry.count("fallback.hits", key=key)
     with _warned_lock:
         if key in _warned:
             return
         _warned.add(key)
+    telemetry.event("fallback", key, message=msg)
     warnings.warn(msg, RuntimeWarning, stacklevel=stacklevel)
 
 

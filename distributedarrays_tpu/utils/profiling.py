@@ -10,6 +10,13 @@ framework's terms:
 - ``annotate(name)`` — named trace spans for host-side phases.
 - ``op_timer()`` — lightweight wall-clock accounting of eager ops with
   marginal-cost support (see bench.py for the tunnel caveat).
+
+Framework-level accounting (byte counts, reshard/fallback/retrace
+counters, the event journal) lives in ``distributedarrays_tpu.telemetry``
+— this module is the deep-dive tier on top: ``OpTimer`` publishes its
+spans into telemetry histograms (``optimer.<name>``), and profiler
+captures are journaled so a telemetry report names the trace directories
+that cover it.
 """
 
 from __future__ import annotations
@@ -20,6 +27,8 @@ from collections import defaultdict
 
 import jax
 
+from .. import telemetry as _tm
+
 __all__ = ["trace", "annotate", "OpTimer"]
 
 
@@ -29,11 +38,13 @@ def trace(log_dir: str):
 
     View with `tensorboard --logdir <dir>` or ui.perfetto.dev.
     """
+    _tm.event("profile", "trace_start", dir=str(log_dir))
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        _tm.event("profile", "trace_stop", dir=str(log_dir))
 
 
 def annotate(name: str):
@@ -59,8 +70,12 @@ class OpTimer:
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
             self.counts[name] += 1
+            # mirror into the process-wide registry so OpTimer spans show
+            # up in telemetry.report() next to the comm/fallback counters
+            _tm.observe(f"optimer.{name}", dt)
 
     def report(self) -> dict:
         return {k: {"total_s": self.totals[k], "calls": self.counts[k],

@@ -49,6 +49,64 @@ def test_seed_refresh_allowlist_matches_this_fence():
     assert set(mod._HW_PLATFORMS) == {"tpu", "gpu", "axon"}
 
 
+def _load_tool():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "seed_refresh", _SEED_REFRESH_TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_seed_refresh_gemm_gate_matches_kernel_owner():
+    # the tool's _GEMM_KERNELS gate (which kernels go through the
+    # dispatch-validity filter) must agree with the predicate's own
+    # kernel set, or a new GEMM kernel's winners would promote
+    # unvalidated (or a non-GEMM kernel would be import-gated for
+    # nothing)
+    from distributedarrays_tpu.ops.pallas_gemm import entry_valid_for_seed
+    mod = _load_tool()
+    probe = "256|256|256|float32|float32|tpu|x"
+    for k in mod._GEMM_KERNELS:
+        assert entry_valid_for_seed(k, probe, [128, 128, 128]) is not None, k
+    assert entry_valid_for_seed("flash_attention", probe, [128, 128]) is None
+    assert mod._dispatch_valid("flash_attention", probe, [128, 128]) is None
+
+
+def test_seed_refresh_filters_dispatch_invalid_gemm_winners(tmp_path):
+    # a winner that _resolve_block would reject at dispatch (over-VMEM
+    # tiling, broken alignment) must not ship into the tracked seed
+    # (ADVICE round-5: pre-VMEM-fix winners were dead entries)
+    mod = _load_tool()
+    mod.CACHE = tmp_path / "AUTOTUNE_CACHE.json"
+    mod.SEED = tmp_path / "AUTOTUNE_SEED.json"
+    # an already-shipped dead entry (committed pre-predicate) must be
+    # PRUNED, not just blocked at promotion — otherwise --dry-run keeps
+    # reporting the seed current while dispatch rejects it forever
+    mod.SEED.write_text(json.dumps({
+        "pallas_matmul_int8": {
+            "4096|4096|4096|int8|tpu|TPU v5 lite": [8, 128, 128]},
+    }))
+    mod.CACHE.write_text(json.dumps({
+        "pallas_matmul": {
+            # valid: fits VMEM, aligned, divides
+            "4096|4096|4096|float32|float32|tpu|TPU v5 lite":
+                [512, 512, 512],
+            # over the scoped-VMEM budget at bf16 2048^2 blocks
+            "4096|4096|4096|bfloat16|bfloat16|tpu|TPU v5 lite":
+                [2048, 2048, 1024],
+        },
+        "pallas_matmul_int8": {
+            # m block % 32 != 0 — Mosaic int8 alignment violation
+            "4096|4096|4096|int8|tpu|TPU v5 lite": [8, 128, 128],
+        },
+    }))
+    assert mod.main() == 0
+    seed = json.loads(mod.SEED.read_text())
+    assert seed == {"pallas_matmul": {
+        "4096|4096|4096|float32|float32|tpu|TPU v5 lite": [512, 512, 512]}}
+
+
 def test_seed_entries_visible_after_registry_reset(monkeypatch):
     with open(autotune.seed_path()) as f:
         data = json.load(f)

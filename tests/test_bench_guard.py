@@ -80,7 +80,77 @@ def test_success_pops_every_stale_marker(bench):
     d = {"sort_error": "old", "sort_rerun_error": "old",
          "sort_orphan_running": True}
     bench._guarded(d, "sort", lambda: {"sort_1e7_s": 4.5})
-    assert d == {"sort_1e7_s": 4.5}
+    assert d["sort_1e7_s"] == 4.5
+    assert not any(k.endswith(("_error", "_orphan_running")) for k in d), d
+
+
+def test_success_banks_comm_bytes_column(bench):
+    # every successful config banks its telemetry comms-bytes delta
+    bench._GLOBAL_BUDGET_S = 1e9
+    d = {}
+
+    def cfg():
+        from distributedarrays_tpu import telemetry
+        if telemetry.enabled():
+            telemetry.record_comm("reshard", 4096, op="benchtest",
+                                  journal=False)
+        return {"sort_1e7_s": 4.5}
+
+    bench._guarded(d, "sort", cfg)
+    assert d["sort_1e7_s"] == 4.5
+    from distributedarrays_tpu import telemetry
+    want = 4096 if telemetry.enabled() else 0
+    assert d["sort_comm_bytes_est"] == want
+
+
+def test_failure_banks_no_comm_bytes_column(bench):
+    bench._GLOBAL_BUDGET_S = 1e9
+    d = {}
+    bench._guarded(d, "sort",
+                   lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert "sort_comm_bytes_est" not in d
+
+
+def test_provenance_collapse_carries_probe_attempts(bench):
+    # same-environment headers merge; probe_attempts survives as the max
+    provs = [
+        {"device_kind": "v5e", "method": "direct", "utc": "t1",
+         "probe_attempts": 2},
+        {"device_kind": "v5e", "method": "direct", "utc": "t2",
+         "probe_attempts": 5},
+        {"device_kind": "v5e", "method": "direct",
+         "utcs": ["t0"], "probe_attempts_max": 7},   # already collapsed
+        {"device_kind": "v4", "method": "direct", "utc": "t3",
+         "probe_attempts": 1},
+        {"device_kind": "v4", "method": "direct", "utc": "t4"},  # no attempts
+    ]
+    out = bench._collapse_provenances(provs)
+    assert len(out) == 2
+    v5e = next(c for c in out if c["device_kind"] == "v5e")
+    assert v5e["utcs"] == ["t1", "t2", "t0"]
+    assert v5e["probe_attempts_max"] == 7
+    v4 = next(c for c in out if c["device_kind"] == "v4")
+    assert v4["utcs"] == ["t3", "t4"]
+    assert v4["probe_attempts_max"] == 1
+
+
+def test_details_lock_serializes_invocations(bench, monkeypatch, tmp_path):
+    # second acquirer must wait; with a zero wait budget it gives up with
+    # None instead of proceeding into the read-modify-write race.  flock
+    # is per open-file-description, so two opens conflict even in-process.
+    # Sandboxed lock path: the test must never contend on (or briefly
+    # hold) the repo's production BENCH_DETAILS.lock.
+    monkeypatch.setattr(bench, "_LOCK_PATH", tmp_path / "details.lock")
+    monkeypatch.setenv("DAT_BENCH_LOCK_WAIT_S", "5")
+    lock1 = bench._acquire_details_lock()
+    assert lock1 is not None
+    monkeypatch.setenv("DAT_BENCH_LOCK_WAIT_S", "0")
+    assert bench._acquire_details_lock() is None
+    lock1.close()   # releases the flock
+    monkeypatch.setenv("DAT_BENCH_LOCK_WAIT_S", "5")
+    lock2 = bench._acquire_details_lock()
+    assert lock2 is not None
+    lock2.close()
 
 
 def test_banked_in_handles_dynamic_gemm16k_labels(bench):

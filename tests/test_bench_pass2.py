@@ -91,3 +91,69 @@ def test_every_sentinel_key_exists_in_bench(bp2, bench_src):
         assert f'f"{{prefix}}{suffix}"' in src, key
         assert re.search(r'\w+\([^()]*"%s"\)' % re.escape(lbl), src), \
             f"{lbl} never passed as a prefix argument"
+
+
+@pytest.fixture()
+def bp3(tmp_path, monkeypatch):
+    # bench_pass3 reuses bench_pass2's module-level paths; point the
+    # liveness probes at a sandbox so the repo's real markers/logs (which
+    # may exist from an actual round) cannot leak into the assertions
+    spec = importlib.util.spec_from_file_location(
+        "bench_pass3", REPO / "tools" / "bench_pass3.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod.p2, "DONE", tmp_path / "pass2.done")
+    monkeypatch.setattr(mod.p2, "LOG", tmp_path / "pass2.log")
+    return mod
+
+
+def test_pass2_active_missing_log_waits_out_grace(bp3):
+    import time
+    # no DONE marker, no log yet: pass-2 may simply not have launched —
+    # within the grace window this must read as ACTIVE (the round-5 race:
+    # treating the absent log as "finished" had pass-3 stealing the queue
+    # while pass-2 spun up)
+    now = time.time()
+    assert bp3.pass2_active(armed_at=now) is True
+    assert bp3.pass2_active(armed_at=None) is True      # no grace started
+    # past the grace with still no log: pass-2 genuinely never ran
+    assert bp3.pass2_active(
+        armed_at=now - bp3.NO_LOG_GRACE_S - 1) is False
+
+
+def test_pass2_active_done_marker_wins(bp3):
+    import time
+    bp3.p2.DONE.write_text("done")
+    assert bp3.pass2_active(armed_at=time.time()) is False
+
+
+def test_pass2_active_log_heartbeat(bp3):
+    import os
+    import time
+    armed = time.time()
+    bp3.p2.LOG.write_text("heartbeat")
+    assert bp3.pass2_active(armed_at=armed) is True      # fresh log
+    stale = time.time() - bp3.STALE_LOG_S - 10
+    os.utime(bp3.p2.LOG, (stale, stale))
+    assert bp3.pass2_active(armed_at=armed) is False     # dead/wedged
+
+
+def test_pass2_active_ignores_previous_round_markers(bp3):
+    import os
+    import time
+    # gitignored markers survive between rounds: a day-old DONE file or
+    # log must read as ABSENT (grace logic), not as "this round's pass-2
+    # already finished" — or the arming race recurs on every round after
+    # the first
+    old = time.time() - bp3.MARKER_FRESH_S - 60
+    bp3.p2.DONE.write_text("previous round")
+    os.utime(bp3.p2.DONE, (old, old))
+    bp3.p2.LOG.write_text("previous round heartbeat")
+    os.utime(bp3.p2.LOG, (old, old))
+    now = time.time()
+    assert bp3.pass2_active(armed_at=now) is True          # within grace
+    assert bp3.pass2_active(
+        armed_at=now - bp3.NO_LOG_GRACE_S - 1) is False    # grace expired
+    # a FRESH done marker still wins immediately
+    bp3.p2.DONE.write_text("this round")
+    assert bp3.pass2_active(armed_at=now) is False

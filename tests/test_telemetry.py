@@ -1,0 +1,378 @@
+"""Telemetry subsystem tests: counter/journal correctness under threads,
+byte-accounting sanity for known transfers, disabled-mode zero-overhead,
+CLI summary round-trip, fallback-site counting, and the end-to-end
+scripted-workload acceptance check (distribute → matmul → copyto_
+reshard → gather)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import telemetry
+from distributedarrays_tpu.telemetry.fixtures import telemetry_capture  # noqa: F401 (fixture)
+from distributedarrays_tpu.telemetry.summarize import (read_journal,
+                                                       summarize)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_counters_gauges_histograms(telemetry_capture):
+    tm = telemetry_capture
+    tm.count("x")
+    tm.count("x", 2)
+    tm.count("x", kernel="k1")
+    assert tm.counter_value("x") == 3
+    assert tm.counter_value("x", kernel="k1") == 1
+    assert tm.counter_value("never") == 0
+    tm.set_gauge("g", 7.5)
+    assert tm.gauge_value("g") == 7.5
+    for v in (1.0, 3.0, 2.0):
+        tm.observe("h", v)
+    h = tm.report()["histograms"]["h"]
+    assert h["count"] == 3 and h["min"] == 1.0 and h["max"] == 3.0
+    assert abs(h["mean"] - 2.0) < 1e-12
+
+
+def test_thread_safety_counters_and_journal(telemetry_capture):
+    tm = telemetry_capture
+    NT, NC, NE = 8, 500, 25
+
+    def worker(i):
+        for _ in range(NC):
+            tm.count("threads.c")
+        for j in range(NE):
+            tm.event("threadtest", "e", worker=i, j=j)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(NT)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert tm.counter_value("threads.c") == NT * NC
+    evs = tm.events("threadtest")
+    assert len(evs) == NT * NE
+    seqs = [e["seq"] for e in evs]
+    assert len(set(seqs)) == len(seqs), "duplicate journal seq under threads"
+    ts = [e["t"] for e in sorted(evs, key=lambda e: e["seq"])]
+    assert all(b >= a for a, b in zip(ts, ts[1:])), \
+        "journal timestamps not monotone"
+
+
+def test_journal_file_is_append_only_jsonl(telemetry_capture):
+    tm = telemetry_capture
+    path = tm.journal_path()
+    tm.event("cat1", "a", k=1)
+    tm.event("cat1", "b", k=2)
+    lines = Path(path).read_text().splitlines()
+    assert len(lines) == 2
+    recs = [json.loads(l) for l in lines]
+    assert [r["name"] for r in recs] == ["a", "b"]
+    assert recs[1]["t"] >= recs[0]["t"]
+    assert recs[1]["seq"] > recs[0]["seq"]
+
+
+def test_once_key_dedups_journal_not_counters(telemetry_capture):
+    tm = telemetry_capture
+    for _ in range(5):
+        tm.record_comm("spmdtest", 10, op="x", once_key="only-once")
+    assert len(tm.events("comm")) == 1
+    assert tm.comm_bytes("spmdtest") == 50  # counters saw all 5
+
+
+# ---------------------------------------------------------------------------
+# disabled mode
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_zero_events_near_zero_overhead(telemetry_capture,
+                                                      tmp_path):
+    tm = telemetry_capture
+    tm.reset()
+    tm.configure(str(tmp_path / "never.jsonl"))
+    tm.disable()
+    t0 = time.perf_counter()
+    for _ in range(50_000):
+        tm.count("hot", n=1, kernel="x")
+        tm.record_comm("reshard", 123, journal=True)
+        tm.event("cat", "n", k=1)
+    elapsed = time.perf_counter() - t0
+    r = tm.report()
+    assert r["enabled"] is False
+    assert r["counters"] == {} and r["comm"]["total_bytes"] == 0
+    assert r["events"]["recorded"] == 0
+    assert not (tmp_path / "never.jsonl").exists(), \
+        "disabled telemetry must never create a journal file"
+    # 150k no-op calls; generous bound — this is a smoke check that the
+    # disabled path is a flag test, not a lock acquisition
+    assert elapsed < 2.0, f"disabled-mode overhead too high: {elapsed:.3f}s"
+    tm.enable()
+    tm.count("hot")
+    assert tm.counter_value("hot") == 1
+
+
+# ---------------------------------------------------------------------------
+# byte accounting
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_byte_accounting_known_copyto(telemetry_capture):
+    tm = telemetry_capture
+    # 1-D (row) layout → 2-D-ish relayout via copyto_: one reshard of
+    # exactly the payload size (16*8 float32 = 512 bytes)
+    src = dat.distribute(np.zeros((16, 8), np.float32), dist=(8, 1))
+    dest = dat.dzeros((16, 8), dist=(1, 8))
+    ops0 = tm.report()["comm"]["by_kind"].get("reshard", {}).get("ops", 0)
+    b0 = tm.comm_bytes("reshard")
+    dat.copyto_(dest, src)
+    assert tm.comm_bytes("reshard") - b0 == 16 * 8 * 4
+    by_kind = tm.report()["comm"]["by_kind"]
+    assert by_kind["reshard"]["ops"] - ops0 == 1
+    assert tm.counter_value("op.copyto_") == 1
+
+
+def test_h2d_and_d2h_byte_accounting(telemetry_capture):
+    tm = telemetry_capture
+    a = np.ones((32, 4), np.float32)
+    d = dat.distribute(a)
+    assert tm.comm_bytes("h2d") == a.nbytes
+    _ = np.asarray(d)
+    assert tm.comm_bytes("d2h") == a.nbytes
+
+
+def test_nbytes_of():
+    assert telemetry.nbytes_of(np.zeros((4, 4), np.float32)) == 64
+    assert telemetry.nbytes_of(jnp.zeros((2, 2), jnp.int32)) == 16
+    assert telemetry.nbytes_of(b"abcd") == 4
+    assert telemetry.nbytes_of(object()) == 0
+
+
+# ---------------------------------------------------------------------------
+# fallback sites (former warn_once-only degradations)
+# ---------------------------------------------------------------------------
+
+
+def test_warn_once_site_counts_exactly_once_per_trigger(telemetry_capture,
+                                                        recwarn):
+    from distributedarrays_tpu.utils.debug import warn_once
+    tm = telemetry_capture
+    warn_once("telemetrytest-site", "degraded")
+    assert tm.counter_value("fallback.hits", key="telemetrytest-site") == 1
+    assert len(tm.events("fallback")) == 1
+    # a second hit of the same site: counted (hits are per-occurrence),
+    # journaled and warned only once
+    warn_once("telemetrytest-site", "degraded")
+    assert tm.counter_value("fallback.hits", key="telemetrytest-site") == 2
+    assert len(tm.events("fallback")) == 1
+
+
+def test_real_fallback_site_increments_counter(telemetry_capture):
+    # dreduce host fallback: an untraceable binary op takes the documented
+    # host-fold path and must surface as a counted fallback event
+    tm = telemetry_capture
+    d = dat.distribute(np.arange(8, dtype=np.float32))
+
+    def opaque(a, b):          # concretizes → cannot trace
+        return a + b if float(np.asarray(a).reshape(-1)[0]) >= -1e30 else b
+
+    with pytest.warns(RuntimeWarning):
+        dat.dreduce(opaque, d)
+    hits = {k: v for k, v in tm.report()["counters"].items()
+            if k.startswith("fallback.hits{key=dreduce-host-")}
+    assert list(hits.values()) == [1], hits
+    assert len(tm.events("fallback")) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI / summarize round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_cli_summary_roundtrips_journal(telemetry_capture, capsys):
+    tm = telemetry_capture
+    tm.record_comm("reshard", 1024, op="rebind")
+    tm.record_comm("h2d", 256, op="device_put")
+    tm.event("jit", "build", fn="f")
+    path = tm.journal_path()
+    s = summarize(read_journal(path))
+    assert s["events"] == 3
+    assert s["comm"]["total_bytes"] == 1280
+    assert s["comm"]["by_kind"]["reshard"]["ops"] == 1
+    assert s["by_category"] == {"comm": 2, "jit": 1}
+    from distributedarrays_tpu.telemetry.__main__ import main
+    assert main([path, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == s
+    assert main([path]) == 0
+    text = capsys.readouterr().out
+    assert "reshard" in text and "1.2 KiB" in text
+
+
+def test_read_journal_tolerates_torn_line(tmp_path):
+    p = tmp_path / "j.jsonl"
+    p.write_text('{"cat": "comm", "name": "h2d", "bytes": 4, "t": 0.1}\n'
+                 '{"cat": "comm", "na')          # torn mid-write
+    evs = read_journal(str(p))
+    s = summarize(evs)
+    assert s["comm"]["total_bytes"] == 4
+    assert s["by_category"]["_journal"] == 1     # malformed-line marker
+
+
+def test_report_dump_roundtrip(telemetry_capture, tmp_path):
+    tm = telemetry_capture
+    tm.count("a")
+    out = tm.dump(str(tmp_path / "report.json"))
+    loaded = json.loads(Path(out).read_text())
+    assert loaded["counters"]["a"] == 1
+    assert loaded["enabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# instrumented subsystems
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_hit_miss_events(telemetry_capture):
+    from distributedarrays_tpu.utils import autotune
+    tm = telemetry_capture
+    key = "telemetry|test|key"
+    assert autotune.get("telemetry_test_kernel", key) is None
+    assert tm.counter_value("autotune.miss",
+                            kernel="telemetry_test_kernel") == 1
+    assert len(tm.events("autotune")) == 1
+    autotune.record("telemetry_test_kernel", key, [1, 2, 3])
+    assert autotune.get("telemetry_test_kernel", key) == [1, 2, 3]
+    assert tm.counter_value("autotune.hit",
+                            kernel="telemetry_test_kernel") == 1
+    # repeated misses: counted every time, journaled once
+    autotune.get("telemetry_test_kernel", "other|key")
+    autotune.get("telemetry_test_kernel", "other|key")
+    assert tm.counter_value("autotune.miss",
+                            kernel="telemetry_test_kernel") == 3
+    assert len(tm.events("autotune")) == 2
+
+
+def test_checkpoint_phase_events(telemetry_capture, tmp_path):
+    from distributedarrays_tpu.utils import checkpoint
+    tm = telemetry_capture
+    d = dat.distribute(np.arange(16, dtype=np.float32))
+    checkpoint.save(tmp_path / "ckpt", {"d": d})
+    restored = checkpoint.load(tmp_path / "ckpt")
+    assert np.allclose(np.asarray(restored["d"]), np.asarray(d))
+    names = [e.get("name") for e in tm.events("checkpoint")]
+    assert names == ["save_start", "save_end", "restore_start",
+                     "restore_end"]
+    end = tm.events("checkpoint")[1]
+    assert end["bytes"] == 64 and end["arrays"] == 1
+    assert tm.counter_value("checkpoint.saves") == 1
+    assert tm.counter_value("checkpoint.restores") == 1
+
+
+def test_collectives_rec_is_counted_and_flagged_traced(telemetry_capture):
+    # unit-level: the shared trace-time recorder the collective wrappers
+    # call — runs regardless of whether this jax build has jax.shard_map
+    from distributedarrays_tpu.parallel import collectives as C
+    tm = telemetry_capture
+    C._rec("all_gather", np.zeros((4, 2), np.float32), "p", op="pgather")
+    evs = tm.events("comm")
+    assert len(evs) == 1 and evs[0]["traced"] is True
+    assert evs[0]["axis"] == "p" and evs[0]["bytes"] == 32
+    assert tm.comm_bytes("all_gather") == 32
+
+
+def test_collectives_record_traced_comm(telemetry_capture):
+    import jax
+    from distributedarrays_tpu.parallel import collectives as C
+    from jax.sharding import PartitionSpec as P
+    if not hasattr(jax, "shard_map"):
+        pytest.skip("jax.shard_map unavailable in this jax build "
+                    "(run_spmd is broken at seed on this environment)")
+    tm = telemetry_capture
+    mesh = C.spmd_mesh(4)
+    fn = C.run_spmd(lambda x: C.pshift(x, "p"), mesh,
+                    in_specs=P("p"), out_specs=P("p"))
+    x = jnp.arange(8, dtype=jnp.float32)
+    np.asarray(fn(x))          # trace + run
+    evs = [e for e in tm.events("comm") if e.get("name") == "ppermute"]
+    assert len(evs) == 1 and evs[0]["traced"] is True
+    assert evs[0]["axis"] == "p" and evs[0]["bytes"] == 2 * 4  # per-rank block
+    # counted once per trace (>= one 8-byte record; lowering may re-enter)
+    b = tm.comm_bytes("ppermute")
+    assert b >= 8 and b % 8 == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the scripted workload
+# ---------------------------------------------------------------------------
+
+_WORKLOAD = """
+import _cpu_harness; _cpu_harness.force_cpu_mesh()
+import numpy as np
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import telemetry
+A = dat.distribute(np.arange(64, dtype=np.float32).reshape(8, 8))
+B = dat.distribute(np.ones((8, 8), dtype=np.float32))
+C = A @ B
+dest = dat.dzeros((8, 8), dist=(1, 8))
+dat.copyto_(dest, C)
+g = dat.gather(dest)
+import json
+r = telemetry.report()
+print("REPORT " + json.dumps(r))
+"""
+
+
+def _run_workload(env):
+    return subprocess.run(
+        [sys.executable, "-c", _WORKLOAD], cwd=str(REPO),
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **env})
+
+
+def test_scripted_workload_acceptance(tmp_path):
+    jpath = tmp_path / "journal.jsonl"
+    r = _run_workload({"DA_TPU_TELEMETRY": "1",
+                       "DA_TPU_TELEMETRY_JOURNAL": str(jpath)})
+    assert r.returncode == 0, r.stderr[-2000:]
+    rep = json.loads(r.stdout.split("REPORT ", 1)[1])
+    # nonzero reshard count and nonzero estimated comm bytes
+    assert rep["comm"]["by_kind"]["reshard"]["ops"] >= 1
+    assert rep["comm"]["total_bytes"] > 0
+    # at least one journal event per instrumented category the workload
+    # exercises: communication, jit builds, mesh builds, autotune lookups
+    cats = rep["events"]["by_category"]
+    for cat in ("comm", "jit", "mesh", "autotune"):
+        assert cats.get(cat, 0) >= 1, (cat, cats)
+    # the journal file round-trips through the summarizer
+    s = summarize(read_journal(str(jpath)))
+    assert s["comm"]["by_kind"]["reshard"]["ops"] >= 1
+    assert s["comm"]["total_bytes"] > 0
+
+
+def test_scripted_workload_disabled_is_silent(tmp_path):
+    jpath = tmp_path / "journal.jsonl"
+    r = _run_workload({"DA_TPU_TELEMETRY": "0",
+                       "DA_TPU_TELEMETRY_JOURNAL": str(jpath)})
+    assert r.returncode == 0, r.stderr[-2000:]
+    rep = json.loads(r.stdout.split("REPORT ", 1)[1])
+    assert rep["enabled"] is False
+    assert rep["counters"] == {}
+    assert rep["comm"]["total_bytes"] == 0 and rep["comm"]["total_ops"] == 0
+    assert rep["events"]["recorded"] == 0
+    assert not jpath.exists(), \
+        "DA_TPU_TELEMETRY=0 must not create a journal file"

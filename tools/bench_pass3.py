@@ -12,6 +12,11 @@ process), then works the ENTIRE remaining queue: every still-unbanked
 pass-2 label in pass-2's own priority order, the forced
 flash_attn_d128 re-sweep last (it refines an existing number), and the
 hardware pytest leg if pass-2 never got it green.
+
+Even if the wait heuristic misfires and both passes end up invoking
+bench.py concurrently, the banked table stays safe: bench.py serializes
+its whole invocation on BENCH_DETAILS.lock (flock), so the
+read-modify-write of BENCH_DETAILS.json cannot interleave.
 """
 
 import json
@@ -46,18 +51,49 @@ def work_items():
 # holds while the tunnel is down.  65 min of silence means dead/wedged.
 STALE_LOG_S = 3900
 
+# a MISSING log is not evidence pass-2 finished: pass-3 is usually armed
+# BEFORE pass-2 launches, and treating the not-yet-created log as "pass-2
+# done" starts pass-3 stealing the queue while pass-2 spins up — the two
+# then race bench.py invocations against each other (review round-5).
+# Grace covers the launch gap; after it, no DONE and still no log means
+# pass-2 genuinely never ran.
+NO_LOG_GRACE_S = 1800
 
-def pass2_active():
-    """Is pass-2 still working?  DONE marker wins; otherwise its log
-    heartbeat.  Pass-3 must not write to the shared log before or during
-    this wait (its own writes would read as pass-2 liveness) — startup
-    status goes to stdout instead."""
-    if p2.DONE.exists():
-        return False
+# markers older than this are a PREVIOUS round's leftovers (the DONE file
+# and log are gitignored and never deleted): a day-old bench_pass2.done
+# must not read as "this round's pass-2 already finished" — it gets the
+# same treatment as no marker at all.  Within a round, liveness is still
+# decided by the much tighter STALE_LOG_S heartbeat.
+MARKER_FRESH_S = 24 * 3600
+
+
+def _fresh_mtime(path):
+    """mtime of ``path`` if it plausibly belongs to THIS round, else
+    None (missing, or older than MARKER_FRESH_S)."""
     try:
-        mtime = p2.LOG.stat().st_mtime
+        mtime = path.stat().st_mtime
     except OSError:
-        return False     # no log at all: nothing to wait for
+        return None
+    if time.time() - mtime > MARKER_FRESH_S:
+        return None
+    return mtime
+
+
+def pass2_active(armed_at=None):
+    """Is pass-2 still working?  A fresh DONE marker wins; otherwise the
+    log heartbeat.  A missing (or previous-round) log counts as ACTIVE
+    until ``NO_LOG_GRACE_S`` after pass-3 armed (``armed_at``; None = no
+    grace elapsed yet, stay waiting) — only past that grace does "no
+    log" mean "pass-2 never ran".  Pass-3 must not write to the shared
+    log before or during this wait (its own writes would read as pass-2
+    liveness) — startup status goes to stdout instead."""
+    if _fresh_mtime(p2.DONE) is not None:
+        return False
+    mtime = _fresh_mtime(p2.LOG)
+    if mtime is None:
+        if armed_at is None:
+            return True
+        return (time.time() - armed_at) < NO_LOG_GRACE_S
     return (time.time() - mtime) < STALE_LOG_S
 
 
@@ -86,11 +122,12 @@ def _prov_utc():
 
 def main():
     import os
-    wait_deadline = time.time() + float(
+    armed_at = time.time()
+    wait_deadline = armed_at + float(
         os.environ.get("DAT_PASS3_WAIT_HOURS", "10")) * 3600
     print(f"pass3 armed; waiting for pass2 (wait deadline "
           f"{(wait_deadline - time.time()) / 3600:.1f}h)", flush=True)
-    while pass2_active() and time.time() < wait_deadline:
+    while pass2_active(armed_at) and time.time() < wait_deadline:
         time.sleep(60)
     if time.time() >= wait_deadline:
         p2.log("pass3: wait deadline before pass2 finished; nothing run")
