@@ -275,6 +275,23 @@ def _comm_bytes_now():
         return 0
 
 
+def _span_wrapped(label, fn):
+    """Run a config under a ``bench.config`` telemetry span so the
+    journal's comm/span events are attributable per bench label.  The
+    span opens INSIDE the worker thread that executes ``fn`` (contextvar
+    spans do not cross threads).  Imported lazily like
+    ``_comm_bytes_now``; degrades to the bare fn if telemetry is
+    unavailable."""
+    def run():
+        try:
+            from distributedarrays_tpu import telemetry
+        except Exception:
+            return fn()
+        with telemetry.span("bench.config", label=label):
+            return fn()
+    return run
+
+
 _START = time.monotonic()
 # headroom under the driver's own timeout; env override for harness tests
 _GLOBAL_BUDGET_S = float(os.environ.get("DAT_BENCH_BUDGET_S", "3300"))
@@ -371,6 +388,7 @@ def _guarded(details, label, fn, timeout_s=420.0):
                   f"{label}_orphan_running"):
         details.pop(stale, None)
     comm0 = _comm_bytes_now()
+    fn = _span_wrapped(label, fn)
     effective = min(timeout_s * _TSCALE, _remaining())
     finished, res, thread = _run_with_timeout(fn, effective)
     if finished and isinstance(res, Exception) and \

@@ -253,13 +253,15 @@ class DArray:
             psh = L.padded_sharding_for(flat_pids, grid, pdims)
             if tuple(data.shape) == pdims:
                 if getattr(data, "sharding", psh) != psh:
-                    _tm.record_comm("reshard", _tm.nbytes_of(data),
-                                    op="padded_relayout")
-                    data = jax.device_put(data, psh)
+                    with _tm.span("reshard", op="padded_relayout"):
+                        _tm.record_comm("reshard", _tm.nbytes_of(data),
+                                        op="padded_relayout")
+                        data = jax.device_put(data, psh)
             elif tuple(data.shape) == dims:
-                _tm.record_comm("reshard", _tm.nbytes_of(data),
-                                op="blocked_pad")
-                data = _blocked_pad_jit(_cuts_key(cuts), psh)(data)
+                with _tm.span("reshard", op="blocked_pad"):
+                    _tm.record_comm("reshard", _tm.nbytes_of(data),
+                                    op="blocked_pad")
+                    data = _blocked_pad_jit(_cuts_key(cuts), psh)(data)
             else:
                 raise ValueError(f"data shape {tuple(data.shape)} matches "
                                  f"neither dims {dims} nor padded {pdims}")
@@ -517,6 +519,7 @@ class DArray:
 
     # -- data movement -----------------------------------------------------
 
+    @_tm.traced(name="gather")
     def _gather_host(self):
         self._check_open()
         g = self.garray
@@ -550,11 +553,12 @@ class DArray:
         if new_data.shape != tuple(self.dims):
             raise ValueError("rebind shape mismatch")
         if self._padded:
-            if _tm.enabled():
-                _tm.record_comm("reshard", _tm.nbytes_of(new_data),
-                                op="blocked_pad", shape=list(self.dims))
-            self._data = _blocked_pad_jit(_cuts_key(self.cuts),
-                                          self._psharding)(new_data)
+            with _tm.span("reshard", op="blocked_pad"):
+                if _tm.enabled():
+                    _tm.record_comm("reshard", _tm.nbytes_of(new_data),
+                                    op="blocked_pad", shape=list(self.dims))
+                self._data = _blocked_pad_jit(_cuts_key(self.cuts),
+                                              self._psharding)(new_data)
             return
         if new_data.sharding != self._sharding:
             if new_data.size == 0:
@@ -562,10 +566,11 @@ class DArray:
                 # device_put places them fine
                 new_data = jax.device_put(new_data, self._sharding)
             else:
-                if _tm.enabled():
-                    _tm.record_comm("reshard", _tm.nbytes_of(new_data),
-                                    op="rebind", shape=list(self.dims))
-                new_data = _resharder(self._sharding)(new_data)
+                with _tm.span("reshard", op="rebind"):
+                    if _tm.enabled():
+                        _tm.record_comm("reshard", _tm.nbytes_of(new_data),
+                                        op="rebind", shape=list(self.dims))
+                    new_data = _resharder(self._sharding)(new_data)
         self._data = new_data
 
     def with_data(self, new_data: jax.Array, did=None) -> "DArray":
@@ -944,6 +949,11 @@ def _put_global(host, sharding) -> jax.Array:
     process-independent (see ``_spans_processes``); the branches that may
     diverge per process (`device_put` vs `make_array_from_callback`) are
     both collective-free."""
+    with _tm.span("put_global", _journal=False):
+        return _put_global_impl(host, sharding)
+
+
+def _put_global_impl(host, sharding) -> jax.Array:
     if isinstance(host, jax.Array) and _spans_processes(host.sharding):
         if host.sharding.device_set == sharding.device_set:
             # same devices, new layout: ONE compiled identity program
@@ -1259,6 +1269,7 @@ def _as_dims(dims):
     return tuple(int(d) for d in dims)
 
 
+@_tm.traced(name="distribute")
 def distribute(A, procs=None, dist=None, like: DArray | None = None) -> DArray:
     """Distribute a host/device array (reference distribute, darray.jl:544-572).
 

@@ -279,10 +279,13 @@ def _ring_ag_gemm(A: DArray, B: DArray, out_dtype):
     the (p,1)-row-sharded result array."""
     p = A.pids.shape[0]
     procs = tuple(int(q) for q in A.pids.flat)
-    mesh, ax, fn = _ring_ag_jit(procs, p, str(jnp.dtype(out_dtype)))
-    a = jax.device_put(A.garray, NamedSharding(mesh, P(ax, None)))
-    b = jax.device_put(B.garray, NamedSharding(mesh, P(ax, None)))
-    return fn(a, b)
+    with _tm.span("matmul.ring_ag", ranks=p):
+        mesh, ax, fn = _ring_ag_jit(procs, p, str(jnp.dtype(out_dtype)))
+        with _tm.span("matmul.ring_ag.place", _journal=False):
+            a = jax.device_put(A.garray, NamedSharding(mesh, P(ax, None)))
+            b = jax.device_put(B.garray, NamedSharding(mesh, P(ax, None)))
+        with _tm.span("matmul.ring_ag.compute", _journal=False):
+            return fn(a, b)
 
 
 def _dist_impl_choice(m, n, k, p, a_dtype, b_dtype):
@@ -398,12 +401,15 @@ def _summa_gemm(A: DArray, B: DArray, out_dtype):
     the (r,c)-block-sharded result array."""
     r, c = A.pids.shape
     procs = tuple(int(q) for q in A.pids.flat)
-    mesh, (ax_r, ax_c), fn = _summa_jit(procs, r, c,
-                                        str(jnp.dtype(out_dtype)))
-    sh = NamedSharding(mesh, P(ax_r, ax_c))
-    a = jax.device_put(A.garray, sh)
-    b = jax.device_put(B.garray, sh)
-    return fn(a, b)
+    with _tm.span("matmul.summa", grid=f"{r}x{c}"):
+        mesh, (ax_r, ax_c), fn = _summa_jit(procs, r, c,
+                                            str(jnp.dtype(out_dtype)))
+        sh = NamedSharding(mesh, P(ax_r, ax_c))
+        with _tm.span("matmul.summa.place", _journal=False):
+            a = jax.device_put(A.garray, sh)
+            b = jax.device_put(B.garray, sh)
+        with _tm.span("matmul.summa.compute", _journal=False):
+            return fn(a, b)
 
 
 def _default_impl_timer(op, a, b):
@@ -627,6 +633,7 @@ def tune_matmul_impl_summa(m, n, k, g=None, dtype=jnp.float32, timer=None,
         timer or _default_impl_timer, persist)
 
 
+@_tm.traced(name="matmul")
 def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
     """C = alpha*A*B [+ beta*C] — distributed GEMM / matvec.
 

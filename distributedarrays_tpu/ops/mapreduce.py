@@ -17,6 +17,7 @@ XLA resharding.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import inspect
 from typing import Callable
@@ -57,7 +58,9 @@ def _reduce_impl(d, mapper: Callable | None, reducer: Callable, dims=None,
     """
     x = _unwrap(d)
     axes = _norm_dims(dims, np.ndim(x))
-    res = _reduction_jit(mapper, reducer, axes, tuple(sorted(kw.items())))(x)
+    with _tm.span("mapreduce.reduce", _journal=False):
+        res = _reduction_jit(mapper, reducer, axes,
+                             tuple(sorted(kw.items())))(x)
     if axes is None:
         return res
     # result keeps the pid-grid shape of the source with the reduced dims
@@ -128,11 +131,12 @@ def dmapreduce(f: Callable, op_name_or_fn, d, dims=None):
     reduce) with a host fold as the untraceable-op fallback.
     """
     _tm.count("op.mapreduce")
-    reducer = _REDUCERS.get(op_name_or_fn, op_name_or_fn) \
-        if isinstance(op_name_or_fn, str) else op_name_or_fn
-    if callable(reducer) and _is_binary_op(reducer):
-        return _binary_reduce(d, f, reducer, dims)
-    return _reduce_impl(d, f, reducer, dims=dims)
+    with _tm.span("mapreduce"):
+        reducer = _REDUCERS.get(op_name_or_fn, op_name_or_fn) \
+            if isinstance(op_name_or_fn, str) else op_name_or_fn
+        if callable(reducer) and _is_binary_op(reducer):
+            return _binary_reduce(d, f, reducer, dims)
+        return _reduce_impl(d, f, reducer, dims=dims)
 
 
 def dreduce(op_name_or_fn, d, dims=None):
@@ -197,7 +201,8 @@ def _binary_reduce(d, mapper, op, dims):
     if n == 0:
         raise ValueError("reduce of empty DArray with no init value")
     try:
-        res = _binary_fold_jit(mapper, op, axes, ndim)(x)
+        with _tm.span("mapreduce.tree", _journal=False):
+            res = _binary_fold_jit(mapper, op, axes, ndim)(x)
     except (jax.errors.JAXTypeError, TypeError):
         # op cannot trace (concretizes/branches on values): host fold.
         # Device-side failures (OOM, bad shapes) surface unmasked.
@@ -206,7 +211,8 @@ def _binary_reduce(d, mapper, op, dims):
                   f"dreduce: op {_fn_site(op)} "
                   "cannot be jax-traced; gathering to host for a scalar "
                   "left-fold")
-        res = _binary_reduce_host(np.asarray(x), mapper, op, axes, ndim)
+        with _tm.span("mapreduce.host_fold"):
+            res = _binary_reduce_host(np.asarray(x), mapper, op, axes, ndim)
     if axes is None:
         return res
     res = jnp.expand_dims(jnp.asarray(res), axes)  # keepdims, like _reduce_impl
@@ -536,10 +542,15 @@ def samedist(d: DArray, like: DArray) -> DArray:
         raise ValueError(f"dims mismatch: {d.dims} vs {like.dims}")
     from ..darray import _fresh
     g = d.garray
-    if _tm.enabled() and g.sharding != like.sharding:
-        _tm.record_comm("reshard", _tm.nbytes_of(g), op="samedist",
-                        shape=list(d.dims))
-    return like.with_data(_fresh(jax.device_put(g, like.sharding), g))
+    # span only when bytes actually move — an aligned samedist is a no-op
+    # placement and must not dilute the "reshard" span aggregates
+    cm = _tm.span("reshard", op="samedist") \
+        if g.sharding != like.sharding else contextlib.nullcontext()
+    with cm:
+        if _tm.enabled() and g.sharding != like.sharding:
+            _tm.record_comm("reshard", _tm.nbytes_of(g), op="samedist",
+                            shape=list(d.dims))
+        return like.with_data(_fresh(jax.device_put(g, like.sharding), g))
 
 
 # ---------------------------------------------------------------------------

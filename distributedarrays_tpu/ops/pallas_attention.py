@@ -40,6 +40,7 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 from .pallas_gemm import _on_tpu
+from .. import telemetry as _tm
 
 __all__ = ["flash_attention", "flash_block_size", "tuned_flash_config",
            "flash_attention_hop",
@@ -621,6 +622,7 @@ def tuned_flash_config(S, H, D, dtype, causal: bool,
     return block_q, block_k, head_fold
 
 
+@_tm.traced(name="pallas.flash_attention")
 def flash_attention(q, k, v, causal: bool = False, scale: float | None = None,
                     block_q: int | None = None, block_k: int | None = None,
                     head_fold: int | None = None,

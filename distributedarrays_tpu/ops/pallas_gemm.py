@@ -34,6 +34,8 @@ try:  # TPU-only namespace; absent/unusable off-TPU
 except Exception:  # pragma: no cover
     pltpu = None
 
+from .. import telemetry as _tm
+
 __all__ = ["pallas_matmul", "pallas_matmul_int8", "quantized_matmul",
            "quantize_rows", "entry_valid_for_seed"]
 
@@ -255,6 +257,7 @@ def _build(m, n, k, bm, bn, bk, dtype_str, epilogue, interpret):
     return jax.jit(call)
 
 
+@_tm.traced(name="pallas.matmul")
 def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
                   epilogue: Callable | None = None,
                   interpret: bool | None = None):
@@ -353,6 +356,7 @@ def _build_int8(m, n, k, bm, bn, bk, out_dtype_str, interpret):
     return jax.jit(call)
 
 
+@_tm.traced(name="pallas.matmul_int8")
 def pallas_matmul_int8(qa, qb, a_scale, b_scale,
                        block: tuple[int, int, int] | None = None,
                        out_dtype=jnp.float32, interpret: bool | None = None):

@@ -82,18 +82,22 @@ class _Mailbox:
 
     def take(self, match: Callable[[tuple], bool], failed: "threading.Event",
              timeout: float):
-        deadline = time.monotonic() + timeout
-        with self._cond:
-            while True:
-                m = _scan_stash(self._msgs, match)
-                if m is not None:
-                    return m
-                if failed.is_set():
-                    raise RuntimeError(_PEER_ABORT)
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise _receive_timeout(timeout, self._msgs)
-                self._cond.wait(min(remaining, 0.1))
+        # span: the drain wait is where SPMD programs spend their blocked
+        # time — aggregate-only (_journal=False: a chatty ring would emit
+        # thousands of journal lines), visible in span_stats()/report()
+        with _tm.span("spmd.mailbox.drain", _journal=False):
+            deadline = time.monotonic() + timeout
+            with self._cond:
+                while True:
+                    m = _scan_stash(self._msgs, match)
+                    if m is not None:
+                        return m
+                    if failed.is_set():
+                        raise RuntimeError(_PEER_ABORT)
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise _receive_timeout(timeout, self._msgs)
+                    self._cond.wait(min(remaining, 0.1))
 
 
 class SPMDContext:
@@ -339,6 +343,7 @@ def gather_spmd(x, root: int, tag: Any = None,
 # ---------------------------------------------------------------------------
 
 
+@_tm.traced(name="spmd.run")
 def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
          context: SPMDContext | None = None, timeout: float = 300.0,
          backend: str = "thread"):
@@ -386,7 +391,11 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
         core._rank_tls.rank = rank
         _tls.ctxt = ctx
         try:
-            results[rank] = f(*args)
+            # per-rank step span: a fresh thread has no contextvar parent,
+            # so rank timelines are independent root spans (one Perfetto
+            # track per rank thread)
+            with _tm.span("spmd.step", rank=rank):
+                results[rank] = f(*args)
         except BaseException as e:  # noqa: BLE001 — propagated to caller
             errors[rank] = e
             ctx._failed.set()

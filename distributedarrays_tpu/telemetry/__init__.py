@@ -19,8 +19,14 @@ Quick use::
     print(telemetry.report())             # nested dict
     telemetry.dump("telemetry.json")      # JSON export
 
-    # offline: summarize a journal
-    #   python -m distributedarrays_tpu.telemetry run.jsonl
+    # attribute time/bytes to phases with hierarchical spans
+    with telemetry.span("train.step", step=i):
+        ...                            # comm/events inside carry span_id
+
+    # offline: summarize / export a journal
+    #   python -m distributedarrays_tpu.telemetry summarize run.jsonl
+    #   python -m distributedarrays_tpu.telemetry trace run.jsonl -o t.json
+    #   python -m distributedarrays_tpu.telemetry prom report.json
 
 Disable with ``DA_TPU_TELEMETRY=0`` (or :func:`disable`): every recording
 call becomes a boolean check and an immediate return, no journal file is
@@ -34,6 +40,9 @@ from .core import (enabled, enable, disable, configure, reset, count,
                    gauge_value, comm_bytes, events, journal_path, nbytes_of,
                    report, dump)
 from .summarize import read_journal, summarize, format_summary
+from .tracing import (Span, span, traced, current_span, current_span_id,
+                      spans, span_stats)
+from .export import to_perfetto, to_prometheus
 
 __all__ = [
     "enabled", "enable", "disable", "configure", "reset",
@@ -41,4 +50,6 @@ __all__ = [
     "counter_value", "gauge_value", "comm_bytes", "events",
     "journal_path", "nbytes_of", "report", "dump",
     "read_journal", "summarize", "format_summary",
+    "Span", "span", "traced", "current_span", "current_span_id",
+    "spans", "span_stats", "to_perfetto", "to_prometheus",
 ]

@@ -1,26 +1,133 @@
-"""CLI: summarize a telemetry journal (JSONL) or report export (JSON).
+"""CLI: summarize or export a telemetry journal / report.
 
 Usage::
 
-    python -m distributedarrays_tpu.telemetry JOURNAL.jsonl [--json]
+    python -m distributedarrays_tpu.telemetry summarize RUN.jsonl [--json]
+    python -m distributedarrays_tpu.telemetry trace RUN.jsonl [-o out.json]
+    python -m distributedarrays_tpu.telemetry prom REPORT.json [-o out.prom]
+    python -m distributedarrays_tpu.telemetry RUN.jsonl [--json]   # legacy
 
-Prints event counts by category, communication bytes by kind, and the
-journal's time span.  ``--json`` emits the summary as JSON instead of the
-text table.  The summarizer itself (``telemetry/summarize.py``) is pure
-stdlib; running it via ``-m`` imports the parent package (JAX present),
-so on a JAX-less machine import ``summarize.py`` directly instead.
+``summarize`` prints event counts by category, communication bytes by
+kind (eager vs traced), span rollups, and top fallback keys; ``trace``
+converts a journal to Perfetto/Chrome trace-event JSON (open at
+ui.perfetto.dev); ``prom`` renders a ``telemetry.dump()`` report — or,
+given a journal, the registry reconstructed from it — in Prometheus
+text exposition format.  ``-`` reads stdin.  The first form without a
+subcommand is the PR-1 interface and behaves exactly like ``summarize``.
+
+The converters (``summarize.py``, ``export.py``) are pure stdlib;
+running via ``-m`` imports the parent package (JAX present), so on a
+JAX-less machine import those modules directly instead.
 """
 
 from __future__ import annotations
 
 import argparse
+import io
 import json
 import sys
 
+from .export import to_perfetto, to_prometheus
 from .summarize import read_journal, summarize, format_summary
 
 
+def _read_events(path: str) -> list[dict]:
+    return read_journal(sys.stdin if path == "-" else path)
+
+
+def _write_out(text: str, out_path: str | None) -> None:
+    if out_path and out_path != "-":
+        with open(out_path, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+def _cmd_summarize(args) -> int:
+    s = summarize(_read_events(args.journal))
+    if args.json:
+        json.dump(s, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        format_summary(s, sys.stdout)
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    trace = to_perfetto(_read_events(args.journal))
+    _write_out(json.dumps(trace, indent=None if args.out else 2) + "\n",
+               args.out)
+    return 0
+
+
+def _registry_from_journal(events: list[dict]) -> dict:
+    """Rebuild a report-shaped registry from a journal so ``prom`` works
+    on either input: comm kinds and span rollups survive; counters that
+    never hit the journal (hot-path increments) do not."""
+    s = summarize(events)
+    return {
+        "counters": {f"journal.events{{cat={c}}}": n
+                     for c, n in s["by_category"].items()},
+        "gauges": {}, "histograms": {},
+        "comm": {"total_bytes": s["comm"]["total_bytes"],
+                 "total_ops": s["comm"]["total_ops"],
+                 "by_kind": s["comm"]["by_kind"]},
+        "spans": {"by_name": {k: {"count": v["count"],
+                                  "total_s": v["total_s"],
+                                  "self_s": 0.0, "bytes": v["bytes"]}
+                              for k, v in s["spans"].items()}},
+        "events": {"recorded": s["events"]},
+    }
+
+
+def _cmd_prom(args) -> int:
+    raw = sys.stdin.read() if args.report == "-" else \
+        open(args.report).read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "counters" in doc:
+        registry = doc                      # a telemetry.dump() report
+    else:                                   # a JSONL journal
+        events = read_journal(io.StringIO(raw))
+        registry = _registry_from_journal(events)
+    _write_out(to_prometheus(registry), args.out)
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("summarize", "trace", "prom"):
+        ap = argparse.ArgumentParser(
+            prog="python -m distributedarrays_tpu.telemetry",
+            description="Summarize or export a telemetry journal/report.")
+        sub = ap.add_subparsers(dest="cmd", required=True)
+        p = sub.add_parser("summarize", help="journal -> text/JSON summary")
+        p.add_argument("journal", help="JSONL journal path ('-' = stdin)")
+        p.add_argument("--json", action="store_true",
+                       help="emit the summary as JSON")
+        p.set_defaults(fn=_cmd_summarize)
+        p = sub.add_parser("trace",
+                           help="journal -> Perfetto trace-event JSON")
+        p.add_argument("journal", help="JSONL journal path ('-' = stdin)")
+        p.add_argument("-o", "--out", default=None,
+                       help="output path (default stdout)")
+        p.set_defaults(fn=_cmd_trace)
+        p = sub.add_parser("prom",
+                           help="report JSON (telemetry.dump) or journal "
+                                "-> Prometheus text exposition")
+        p.add_argument("report", help="report/journal path ('-' = stdin)")
+        p.add_argument("-o", "--out", default=None,
+                       help="output path (default stdout)")
+        p.set_defaults(fn=_cmd_prom)
+        args = ap.parse_args(argv)
+        try:
+            return args.fn(args)
+        except OSError as e:
+            print(f"cannot read input: {e}", file=sys.stderr)
+            return 2
+    # legacy interface: bare journal path == `summarize`
     ap = argparse.ArgumentParser(
         prog="python -m distributedarrays_tpu.telemetry",
         description="Summarize a telemetry journal (JSONL).")
@@ -30,18 +137,10 @@ def main(argv=None) -> int:
                     help="emit the summary as JSON")
     args = ap.parse_args(argv)
     try:
-        events = read_journal(sys.stdin if args.journal == "-"
-                              else args.journal)
+        return _cmd_summarize(args)
     except OSError as e:
         print(f"cannot read journal: {e}", file=sys.stderr)
         return 2
-    s = summarize(events)
-    if args.json:
-        json.dump(s, sys.stdout, indent=2, sort_keys=True)
-        sys.stdout.write("\n")
-    else:
-        format_summary(s, sys.stdout)
-    return 0
 
 
 if __name__ == "__main__":

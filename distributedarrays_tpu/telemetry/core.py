@@ -33,6 +33,7 @@ import it without cycles.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
@@ -44,6 +45,7 @@ __all__ = [
     "count", "set_gauge", "observe", "event", "record_comm",
     "counter_value", "gauge_value", "comm_bytes", "events",
     "journal_path", "nbytes_of", "report", "dump",
+    "register_report_section", "register_reset_hook",
 ]
 
 _FALSY = ("0", "false", "off", "no")
@@ -70,9 +72,47 @@ _once_keys: set = set()    # journal dedup for high-frequency sites
 
 _journal_path: str | None = os.environ.get("DA_TPU_TELEMETRY_JOURNAL") or None
 _journal_file = None       # lazily opened append handle
+_journal_bytes = 0         # bytes written (or pre-existing) at the path
+_journal_max = 0           # size cap, sampled from env at file open
+_journal_capped = False    # True once the size cap stopped file mirroring
 
 # one monotonic origin per process so every event timestamp is comparable
 _T0 = time.monotonic()
+
+# the innermost open tracing span (telemetry/tracing.py) on this
+# thread/context — read here so events and comm records are stamped with
+# the span they happened under.  A ContextVar, not thread-local: tasks
+# inherit it, and fresh threads start clean (no cross-thread parents).
+_CURRENT_SPAN: contextvars.ContextVar = contextvars.ContextVar(
+    "da_tpu_current_span", default=None)
+
+# extension points so sibling modules (tracing) can plug into report() /
+# reset() without core importing them (core stays stdlib-only, cycle-free)
+_report_sections: dict = {}
+_reset_hooks: list = []
+
+
+def register_report_section(name: str, fn) -> None:
+    """Add ``name: fn()`` to every :func:`report` (telemetry-internal)."""
+    _report_sections[name] = fn
+
+
+def register_reset_hook(fn) -> None:
+    """Run ``fn()`` on every :func:`reset` (telemetry-internal)."""
+    _reset_hooks.append(fn)
+
+
+def _journal_max_bytes() -> int:
+    """Journal file size cap (``DA_TPU_TELEMETRY_JOURNAL_MAX_MB``, default
+    64): mirroring stops — with a single ``journal.capped`` marker event —
+    instead of growing without bound during long bench/watch runs.
+    Sampled once per file open (not per write) — reconfigure() to pick
+    up a changed value."""
+    try:
+        mb = float(os.environ.get("DA_TPU_TELEMETRY_JOURNAL_MAX_MB", "64"))
+    except ValueError:
+        mb = 64.0
+    return max(int(mb * 1024 * 1024), 1)
 
 
 def _key(name: str, labels: dict) -> str:
@@ -111,11 +151,14 @@ def disable() -> None:
 
 def configure(journal_path: str | None) -> None:
     """Set (or clear, with ``None``) the JSONL journal path.  The file is
-    opened lazily on the next recorded event, in append mode."""
-    global _journal_path
+    opened lazily on the next recorded event, in append mode.  Clears any
+    size-cap latch from a previous path."""
+    global _journal_path, _journal_bytes, _journal_capped
     with _LOCK:
         _close_journal_locked()
         _journal_path = journal_path
+        _journal_bytes = 0
+        _journal_capped = False
 
 
 def journal_path() -> str | None:
@@ -126,7 +169,7 @@ def reset() -> None:
     """Clear every metric, the event buffer, and journal dedup state.
     The enabled flag and the configured journal path are kept; an open
     journal file handle is closed (the file itself is left in place)."""
-    global _events_total
+    global _events_total, _journal_bytes, _journal_capped
     with _LOCK:
         _counters.clear()
         _gauges.clear()
@@ -135,7 +178,11 @@ def reset() -> None:
         _events.clear()
         _once_keys.clear()
         _events_total = 0
+        _journal_bytes = 0
+        _journal_capped = False
         _close_journal_locked()
+        for hook in _reset_hooks:
+            hook()
 
 
 def _close_journal_locked() -> None:
@@ -213,10 +260,20 @@ def event(category: str, name: str | None = None, *,
     ``t`` is seconds since the process's telemetry origin (monotonic —
     safe to order and subtract); ``wall`` is the epoch time for humans.
     ``once_key`` dedups high-frequency sites: only the FIRST event with a
-    given key is journaled (counters still see every occurrence)."""
+    given key is journaled (counters still see every occurrence).
+
+    Events recorded while a tracing span is open carry its ``span_id``
+    (unless the caller already set one) — the nearest *journaled*
+    ancestor's, so a journal's span_id references always resolve to a
+    span event in the same journal (aggregate-only spans never reach
+    it).  Every event also carries the recording thread's ``tid`` — the
+    per-thread track key for the Perfetto export."""
     if not _ENABLED:
         return
     global _events_total
+    sp = _CURRENT_SPAN.get()
+    while sp is not None and not getattr(sp, "journaled", True):
+        sp = sp.parent
     with _LOCK:
         if once_key is not None:
             if once_key in _once_keys:
@@ -225,9 +282,12 @@ def event(category: str, name: str | None = None, *,
         rec = {"seq": _events_total,
                "t": round(time.monotonic() - _T0, 6),
                "wall": round(time.time(), 3),
-               "cat": category}
+               "cat": category,
+               "tid": threading.get_ident()}
         if name is not None:
             rec["name"] = name
+        if sp is not None and "span_id" not in fields:
+            rec["span_id"] = sp.span_id
         for k, v in fields.items():
             rec[k] = _jsonable(v)
         _events_total += 1
@@ -246,17 +306,40 @@ def _jsonable(v):
 
 
 def _write_journal_locked(rec: dict) -> None:
-    global _journal_file
-    if _journal_path is None:
+    global _journal_file, _journal_bytes, _journal_max, _journal_capped, \
+        _events_total
+    if _journal_path is None or _journal_capped:
         return
     try:
         if _journal_file is None:
             parent = os.path.dirname(_journal_path)
             if parent:
                 os.makedirs(parent, exist_ok=True)
+            try:
+                _journal_bytes = os.path.getsize(_journal_path)
+            except OSError:
+                _journal_bytes = 0
+            _journal_max = _journal_max_bytes()
             _journal_file = open(_journal_path, "a")
-        _journal_file.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"
+        _journal_file.write(line)
         _journal_file.flush()
+        _journal_bytes += len(line)
+        if _journal_bytes >= _journal_max:
+            # size cap reached: one marker event, then stop mirroring
+            # (the in-memory buffer and all counters keep recording)
+            cap = {"seq": _events_total,
+                   "t": round(time.monotonic() - _T0, 6),
+                   "wall": round(time.time(), 3),
+                   "cat": "journal", "name": "capped",
+                   "bytes_written": _journal_bytes,
+                   "max_bytes": _journal_max}
+            _events_total += 1
+            _events.append(cap)
+            _journal_file.write(json.dumps(cap) + "\n")
+            _journal_file.flush()
+            _journal_capped = True
+            _close_journal_locked()
     except OSError:
         # telemetry must never take down the workload it observes
         _journal_file = None
@@ -307,10 +390,15 @@ def record_comm(kind: str, nbytes: int, *, axis=None, op: str | None = None,
     collective / replicate / spmd_send / multihost_gather / ...),
     estimated payload ``nbytes``, optional mesh ``axis`` and originating
     ``op``.  Feeds ``comm.ops``/``comm.bytes`` per kind and (unless
-    ``journal=False``) one journal event under category ``"comm"``."""
+    ``journal=False``) one journal event under category ``"comm"``.
+
+    When a tracing span is open, the bytes are also attributed to it
+    (the span's own-bytes tally; parents see them via child rollups at
+    span close) and the journal event carries its ``span_id``."""
     if not _ENABLED:
         return
     nbytes = int(nbytes)
+    sp = _CURRENT_SPAN.get()
     with _LOCK:
         c = _comm.get(kind)
         if c is None:
@@ -318,6 +406,8 @@ def record_comm(kind: str, nbytes: int, *, axis=None, op: str | None = None,
         else:
             c["ops"] += 1
             c["bytes"] += nbytes
+        if sp is not None:
+            sp.bytes += nbytes
     if journal:
         ev = dict(fields)
         if axis is not None:
@@ -347,7 +437,7 @@ def report() -> dict:
         by_cat: dict[str, int] = {}
         for e in _events:
             by_cat[e["cat"]] = by_cat.get(e["cat"], 0) + 1
-        return {
+        out = {
             "enabled": _ENABLED,
             "counters": dict(_counters),
             "gauges": dict(_gauges),
@@ -365,8 +455,17 @@ def report() -> dict:
                 "buffered": len(_events),
                 "by_category": by_cat,
                 "journal_path": _journal_path,
+                "journal_capped": _journal_capped,
             },
         }
+    # outside _LOCK: section providers take it themselves (RLock would
+    # allow reentry, but holding it across foreign code invites deadlock)
+    for name, fn in _report_sections.items():
+        try:
+            out[name] = fn()
+        except Exception:
+            out[name] = {"error": "report section failed"}
+    return out
 
 
 def dump(path: str) -> str:

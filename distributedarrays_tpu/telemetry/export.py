@@ -1,0 +1,210 @@
+"""Exporters: the journal/registry in the two lingua-franca formats.
+
+- :func:`to_perfetto` — Chrome trace-event JSON (open at ui.perfetto.dev
+  or chrome://tracing): spans as complete ("X") slices, one track per
+  recording thread; every other journal event (comm, fallback, autotune,
+  ...) as a thread-scoped instant ("i") on the same timeline.
+- :func:`to_prometheus` — the metrics registry (a :func:`core.report`
+  dict) in Prometheus text exposition format, ``da_tpu_``-prefixed.
+
+Both are pure functions over plain dicts (stdlib only, no JAX), shared
+by the ``python -m distributedarrays_tpu.telemetry trace|prom`` CLI
+subcommands and by tests — a journal pulled off a pod worker converts on
+any machine.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ["to_perfetto", "to_prometheus"]
+
+# journal bookkeeping keys that are not user "args" of an event
+_EVENT_META = ("seq", "t", "wall", "cat", "name", "tid")
+
+
+def _us(seconds) -> float:
+    return round(float(seconds) * 1e6, 3)
+
+
+def to_perfetto(events, spans=None, pid: int = 0) -> dict:
+    """Convert journal ``events`` (list of dicts, e.g. from
+    ``summarize.read_journal``) to a trace-event JSON dict.
+
+    ``spans`` defaults to the events with category ``"span"`` (the
+    journal mirror of every finished span); pass ``tracing.spans()``
+    explicitly to include spans that skipped the journal.  Span ``ts``
+    is the span *start*; all other events are instants at their record
+    time — the shared monotonic origin makes the two line up.  Every
+    entry carries ``ph/ts/dur/pid/tid`` so strict viewers need no
+    defaulting.
+    """
+    if spans is None:
+        spans = [e for e in events if e.get("cat") == "span"]
+    rest = [e for e in events if e.get("cat") != "span"]
+    trace = []
+    threads: dict[int, str] = {}
+    for s in spans:
+        if s.get("dur") is None:
+            continue                       # still-open span snapshot
+        tid = int(s.get("tid") or 0)
+        if s.get("tname"):
+            threads.setdefault(tid, str(s["tname"]))
+        args = {k: s[k] for k in ("span_id", "parent_id", "bytes",
+                                  "child_bytes")
+                if s.get(k) is not None}
+        args.update(s.get("labels") or {})
+        trace.append({"name": str(s.get("name", "?")), "cat": "span",
+                      "ph": "X", "ts": _us(s.get("start", 0.0)),
+                      "dur": _us(s["dur"]), "pid": pid, "tid": tid,
+                      "args": args})
+    for e in rest:
+        tid = int(e.get("tid") or 0)
+        cat = str(e.get("cat", "?"))
+        name = e.get("name")
+        args = {k: v for k, v in e.items()
+                if k not in _EVENT_META and v is not None}
+        trace.append({"name": f"{cat}/{name}" if name is not None else cat,
+                      "cat": cat, "ph": "i", "s": "t",
+                      "ts": _us(e.get("t", 0.0)), "dur": 0,
+                      "pid": pid, "tid": tid, "args": args})
+    for tid, tname in sorted(threads.items()):
+        trace.append({"name": "thread_name", "ph": "M", "ts": 0, "dur": 0,
+                      "pid": pid, "tid": tid, "args": {"name": tname}})
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
+
+
+# label-list splitter: core._key joins "k=v" pairs with "," WITHOUT
+# escaping values, so a value may itself contain commas (e.g. fallback
+# keys built from tuple reprs: "dfft-host-(2, 2)-...").  Split only on
+# commas that start a new "ident=" pair; other commas stay in the value.
+_LABEL_SEP_RE = re.compile(r",(?=[a-zA-Z_][a-zA-Z0-9_.]*=)")
+
+
+def _split_key(key: str) -> tuple[str, dict]:
+    """Invert core._key: ``name{k=v,...}`` -> (name, labels)."""
+    m = _KEY_RE.match(key)
+    if m is None:
+        return key, {}
+    labels = {}
+    raw = m.group("labels")
+    if raw:
+        for part in _LABEL_SEP_RE.split(raw):
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return m.group("name"), labels
+
+
+def _metric_name(name: str) -> str:
+    return "da_tpu_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _esc(v) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _labels_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    return ("{" + ",".join(f'{re.sub(r"[^a-zA-Z0-9_]", "_", k)}="{_esc(v)}"'
+                           for k, v in sorted(labels.items())) + "}")
+
+
+def _num(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class _Family:
+    def __init__(self, name: str, mtype: str, help_: str):
+        self.name, self.mtype, self.help = name, mtype, help_
+        self.samples: list[tuple[str, dict, float]] = []
+
+    def add(self, labels: dict, value, suffix: str = ""):
+        self.samples.append((suffix, labels, value))
+
+    def lines(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.mtype}"]
+        for suffix, labels, value in self.samples:
+            out.append(f"{self.name}{suffix}{_labels_str(labels)} "
+                       f"{_num(value)}")
+        return out
+
+
+def to_prometheus(registry: dict | None = None) -> str:
+    """Render a :func:`core.report` dict (default: the live registry) in
+    Prometheus text exposition format.
+
+    Counters become ``da_tpu_<name>_total``, gauges ``da_tpu_<name>``,
+    histograms summaries (``_count``/``_sum`` plus ``_min``/``_max``
+    gauges); comm accounting and span aggregates get dedicated families
+    labeled by kind/span name.  Label sets round-trip from the
+    registry's ``name{k=v,...}`` keys.
+    """
+    if registry is None:
+        from . import core
+        registry = core.report()
+    fams: dict[str, _Family] = {}
+
+    def fam(name, mtype, help_):
+        f = fams.get(name)
+        if f is None:
+            f = fams[name] = _Family(name, mtype, help_)
+        return f
+
+    for key, value in sorted(registry.get("counters", {}).items()):
+        name, labels = _split_key(key)
+        fam(_metric_name(name) + "_total", "counter",
+            f"counter {name}").add(labels, value)
+    for key, value in sorted(registry.get("gauges", {}).items()):
+        name, labels = _split_key(key)
+        fam(_metric_name(name), "gauge", f"gauge {name}").add(labels, value)
+    for key, h in sorted(registry.get("histograms", {}).items()):
+        name, labels = _split_key(key)
+        base = _metric_name(name)
+        f = fam(base, "summary", f"summary {name}")
+        f.add(labels, h.get("count", 0), "_count")
+        f.add(labels, h.get("total", 0.0), "_sum")
+        fam(base + "_min", "gauge", f"min of {name}").add(
+            labels, h.get("min", 0.0))
+        fam(base + "_max", "gauge", f"max of {name}").add(
+            labels, h.get("max", 0.0))
+    comm = registry.get("comm", {})
+    for kind, c in sorted(comm.get("by_kind", {}).items()):
+        fam("da_tpu_comm_ops_total", "counter",
+            "communication operations by kind").add({"kind": kind},
+                                                    c.get("ops", 0))
+        fam("da_tpu_comm_bytes_total", "counter",
+            "estimated communication bytes by kind").add({"kind": kind},
+                                                         c.get("bytes", 0))
+    for sname, st in sorted(registry.get("spans", {})
+                            .get("by_name", {}).items()):
+        lbl = {"span": sname}
+        fam("da_tpu_span_count_total", "counter",
+            "finished spans by name").add(lbl, st.get("count", 0))
+        fam("da_tpu_span_seconds_total", "counter",
+            "total span wall seconds by name").add(lbl, st.get("total_s", 0))
+        fam("da_tpu_span_self_seconds_total", "counter",
+            "span self (minus children) seconds by name").add(
+                lbl, st.get("self_s", 0))
+        fam("da_tpu_span_bytes_total", "counter",
+            "comm bytes attributed to spans by name").add(
+                lbl, st.get("bytes", 0))
+    ev = registry.get("events", {})
+    if ev:
+        fam("da_tpu_events_recorded_total", "counter",
+            "journal events recorded").add({}, ev.get("recorded", 0))
+    lines: list[str] = []
+    for name in sorted(fams):
+        lines.extend(fams[name].lines())
+    return "\n".join(lines) + "\n" if lines else ""

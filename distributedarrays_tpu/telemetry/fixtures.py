@@ -9,20 +9,62 @@ with a tmp-dir journal, and restores the process's prior state (enabled
 flag + journal path) afterwards — so telemetry tests cannot leak
 configuration into the rest of the suite, and the rest of the suite
 cannot pollute a telemetry assertion.
+
+The yielded object proxies the ``telemetry`` module facade and adds
+span-coverage helpers, so an op test can assert instrumentation without
+parsing the journal::
+
+    def test_my_op_is_traced(telemetry_capture):
+        my_op(...)
+        telemetry_capture.assert_span("my_op")
+        assert telemetry_capture.spans("my_op")[0]["bytes"] > 0
 """
 
 from __future__ import annotations
 
 import pytest
 
-from . import core
+from . import core, tracing
+
+
+class TelemetryCapture:
+    """Module facade plus test-assertion helpers.  Every ``telemetry``
+    attribute (``count``, ``events``, ``report``, ...) resolves through
+    the proxy unchanged."""
+
+    def __init__(self, module):
+        self._module = module
+
+    def __getattr__(self, name):
+        return getattr(self._module, name)
+
+    def spans(self, name: str | None = None) -> list[dict]:
+        """Finished spans (optionally filtered by name) — see
+        ``tracing.spans``."""
+        return tracing.spans(name)
+
+    def assert_span(self, name: str, min_count: int = 1) -> list[dict]:
+        """Assert at least ``min_count`` spans named ``name`` finished;
+        returns the buffered ones (aggregate-only spans count but carry
+        no buffered dicts).  Counts come from ``span_stats`` so neither
+        buffer eviction nor ``_journal=False`` can hide real coverage;
+        the failure message lists what DID run, so a renamed phase is a
+        one-glance fix."""
+        stats = tracing.span_stats()
+        got = stats.get(name, {}).get("count", 0)
+        if got < min_count:
+            raise AssertionError(
+                f"expected >= {min_count} span(s) named {name!r}, "
+                f"got {got}; finished span names: {sorted(stats)}")
+        return tracing.spans(name)
 
 
 @pytest.fixture
 def telemetry_capture(tmp_path):
     """Clean enabled telemetry with a journal at ``tmp_path/journal.jsonl``.
 
-    Yields the ``telemetry`` module facade; the journal path is
+    Yields a :class:`TelemetryCapture` proxying the ``telemetry`` module
+    facade (plus ``spans()`` / ``assert_span()``); the journal path is
     ``telemetry.journal_path()``.
     """
     prev_enabled = core.enabled()
@@ -32,7 +74,7 @@ def telemetry_capture(tmp_path):
     core.enable()
     try:
         from distributedarrays_tpu import telemetry
-        yield telemetry
+        yield TelemetryCapture(telemetry)
     finally:
         core.reset()
         core.configure(prev_path)

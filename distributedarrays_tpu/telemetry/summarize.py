@@ -46,10 +46,14 @@ def read_journal(path_or_file) -> list[dict]:
 def summarize(events: list[dict]) -> dict:
     """Aggregate a journal event list into the summary dict the CLI
     prints: counts by category and by (category, name), communication
-    bytes/ops by kind, and the monotonic time span covered."""
+    bytes/ops by kind — split into trace-time (``traced: true``) vs
+    eager records — fallback hits by key, tracing-span rollups, and the
+    monotonic time span covered."""
     by_cat: dict[str, int] = {}
     by_name: dict[str, int] = {}
     comm: dict[str, dict] = {}
+    fallbacks: dict[str, int] = {}
+    spans: dict[str, dict] = {}
     tmin = tmax = None
     for e in events:
         cat = str(e.get("cat", "?"))
@@ -60,13 +64,32 @@ def summarize(events: list[dict]) -> dict:
             by_name[k] = by_name.get(k, 0) + 1
         if cat == "comm":
             kind = str(name)
-            c = comm.setdefault(kind, {"ops": 0, "bytes": 0})
+            c = comm.setdefault(kind, {"ops": 0, "bytes": 0,
+                                       "traced_ops": 0, "traced_bytes": 0,
+                                       "eager_ops": 0, "eager_bytes": 0})
+            b = int(e.get("bytes", 0) or 0)
             c["ops"] += 1
-            c["bytes"] += int(e.get("bytes", 0) or 0)
+            c["bytes"] += b
+            leg = "traced" if e.get("traced") else "eager"
+            c[leg + "_ops"] += 1
+            c[leg + "_bytes"] += b
+        elif cat == "fallback" and name is not None:
+            fallbacks[str(name)] = fallbacks.get(str(name), 0) + 1
+        elif cat == "span" and name is not None:
+            s = spans.setdefault(str(name),
+                                 {"count": 0, "total_s": 0.0, "bytes": 0})
+            s["count"] += 1
+            s["total_s"] += float(e.get("dur", 0.0) or 0.0)
+            # own + rolled-up child bytes: descendant comm may have landed
+            # on aggregate-only child spans that never reach the journal
+            s["bytes"] += int(e.get("bytes", 0) or 0) + \
+                int(e.get("child_bytes", 0) or 0)
         t = e.get("t")
         if isinstance(t, (int, float)):
             tmin = t if tmin is None else min(tmin, t)
             tmax = t if tmax is None else max(tmax, t)
+    for s in spans.values():
+        s["total_s"] = round(s["total_s"], 6)
     return {
         "events": len(events),
         "span_s": round(tmax - tmin, 6) if tmin is not None else 0.0,
@@ -75,8 +98,13 @@ def summarize(events: list[dict]) -> dict:
         "comm": {
             "total_bytes": sum(c["bytes"] for c in comm.values()),
             "total_ops": sum(c["ops"] for c in comm.values()),
+            "traced_bytes": sum(c["traced_bytes"] for c in comm.values()),
+            "eager_bytes": sum(c["eager_bytes"] for c in comm.values()),
             "by_kind": dict(sorted(comm.items())),
         },
+        "fallbacks": dict(sorted(fallbacks.items(),
+                                 key=lambda kv: (-kv[1], kv[0]))),
+        "spans": dict(sorted(spans.items())),
     }
 
 
@@ -98,10 +126,31 @@ def format_summary(summary: dict, out: TextIO) -> None:
     comm = summary["comm"]
     out.write(f"\ncommunication (estimated): "
               f"{_fmt_bytes(comm['total_bytes'])} over "
-              f"{comm['total_ops']} ops\n")
+              f"{comm['total_ops']} ops")
+    if comm.get("traced_bytes") or comm.get("eager_bytes"):
+        out.write(f"  (eager {_fmt_bytes(comm.get('eager_bytes', 0))}, "
+                  f"traced {_fmt_bytes(comm.get('traced_bytes', 0))})")
+    out.write("\n")
     for kind, c in comm["by_kind"].items():
         out.write(f"  {kind:<20} {c['ops']:>6} ops  "
-                  f"{_fmt_bytes(c['bytes'])}\n")
+                  f"{_fmt_bytes(c['bytes'])}")
+        if "eager_bytes" in c:
+            out.write(f"  [eager {_fmt_bytes(c['eager_bytes'])}, "
+                      f"traced {_fmt_bytes(c['traced_bytes'])}]")
+        out.write("\n")
+    spans = summary.get("spans") or {}
+    if spans:
+        out.write("\nspans (journaled):\n")
+        top_spans = sorted(spans.items(),
+                           key=lambda kv: -kv[1]["total_s"])[:20]
+        for name, s in top_spans:
+            out.write(f"  {name:<28} {s['count']:>6} x  "
+                      f"{s['total_s']:>10.4f}s  {_fmt_bytes(s['bytes'])}\n")
+    fallbacks = summary.get("fallbacks") or {}
+    if fallbacks:
+        out.write("\ntop fallback keys:\n")
+        for key, n in list(fallbacks.items())[:5]:
+            out.write(f"  {key:<40} {n}\n")
     out.write("\ntop events:\n")
     top = sorted(summary["by_name"].items(), key=lambda kv: -kv[1])[:20]
     for name, n in top:

@@ -1,0 +1,266 @@
+"""Hierarchical span tracing: *where* time and bytes go.
+
+PR 1's counters and journal answer "how many bytes / how many
+reshards" — this module answers *which phase they belong to*.  A span is
+a named, labeled interval with a ``span_id``/``parent_id`` pair; spans
+nest through a contextvar parent stack, so every :func:`core.event` and
+:func:`core.record_comm` issued while a span is open is stamped with its
+``span_id`` — comm bytes and fallbacks become attributable to the
+reshard, GEMM stage, or checkpoint phase that caused them.
+
+- :class:`span` — context manager: ``with span("matmul", grid="2x2"):``.
+- :func:`traced` — decorator form: ``@traced(name="reshard")``.
+- Start times share the journal's monotonic origin (``core._T0``), so
+  span intervals and journal events live on one timeline (and one
+  Perfetto track per thread, see ``telemetry/export.py``).
+- Disabled telemetry (``DA_TPU_TELEMETRY=0``): entering a span is the
+  same single boolean check as a counter — no ids, no contextvar write,
+  no journal, nothing allocated beyond the context-manager object.
+
+Spans are *host-side* intervals.  Inside traced code (jit/shard_map
+bodies) a span measures trace time, like PR 1's ``traced=True`` comm
+records — flag such spans with a label if the distinction matters.
+
+Finished spans land in a bounded buffer (:func:`spans`), per-name
+aggregates (:func:`span_stats`: count, total time, self time = total
+minus child time, own bytes, rolled-up child bytes), one journal event
+per span (category ``"span"``, suppressible per call site with
+``_journal=False`` for high-frequency phases), and the ``"spans"``
+section of :func:`core.report`.
+
+Stdlib only, like ``core`` — importable from any layer without cycles.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from collections import deque
+
+from . import core
+
+__all__ = ["Span", "span", "traced", "current_span", "current_span_id",
+           "spans", "span_stats"]
+
+_SPAN_BUFFER_MAX = 8192
+_ids = itertools.count(1)        # CPython-atomic; no lock needed
+_finished: deque = deque(maxlen=_SPAN_BUFFER_MAX)
+_finished_total = 0
+# name -> {count, total_s, self_s, bytes, child_bytes}
+_stats: dict[str, dict] = {}
+
+
+class Span:
+    """One open (then finished) traced interval.  Created by :class:`span`
+    — not directly.  ``bytes`` accumulates every ``record_comm`` issued
+    while this span is innermost; ``child_s``/``child_bytes`` roll up
+    from directly nested spans as they finish."""
+
+    __slots__ = ("name", "labels", "span_id", "parent_id", "parent",
+                 "start", "_t0", "dur", "bytes", "child_s", "child_bytes",
+                 "tid", "tname", "journaled")
+
+    def __init__(self, name: str, labels: dict, parent: "Span | None",
+                 journaled: bool = True):
+        self.name = name
+        self.labels = labels
+        self.span_id = next(_ids)
+        self.parent = parent
+        self.parent_id = parent.span_id if parent is not None else None
+        self.journaled = journaled
+        self._t0 = time.monotonic()
+        self.start = self._t0 - core._T0
+        self.dur = None           # None while open
+        self.bytes = 0
+        self.child_s = 0.0
+        self.child_bytes = 0
+        t = threading.current_thread()
+        self.tid = t.ident or 0
+        self.tname = t.name
+
+    @property
+    def self_s(self) -> float:
+        return (self.dur or 0.0) - self.child_s
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "span_id": self.span_id,
+             "parent_id": self.parent_id,
+             "start": round(self.start, 6),
+             "dur": round(self.dur, 6) if self.dur is not None else None,
+             "bytes": self.bytes, "child_bytes": self.child_bytes,
+             "tid": self.tid, "tname": self.tname}
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        return d
+
+    def __repr__(self):
+        state = f"dur={self.dur:.6f}s" if self.dur is not None else "open"
+        return f"<Span {self.name!r} id={self.span_id} {state}>"
+
+
+class span:
+    """Context manager opening a :class:`Span` named ``name`` with
+    ``labels``.  Yields the Span (or ``None`` when telemetry is
+    disabled).  ``_journal=False`` makes the span aggregate-only: it
+    updates :func:`span_stats` (and parent rollups) but skips BOTH the
+    journal and the bounded :func:`spans` buffer — for phases that fire
+    thousands of times per run (e.g. the SPMD mailbox drain), which
+    would otherwise evict every other span from the buffer."""
+
+    __slots__ = ("_name", "_labels", "_journal", "_sp", "_tok")
+
+    def __init__(self, name: str, _journal: bool = True, **labels):
+        self._name = name
+        self._labels = labels
+        self._journal = _journal
+        self._sp = None
+
+    def __enter__(self):
+        if not core._ENABLED:        # the single-boolean disabled path
+            return None
+        parent = core._CURRENT_SPAN.get()
+        sp = Span(self._name, self._labels, parent, self._journal)
+        self._tok = core._CURRENT_SPAN.set(sp)
+        self._sp = sp
+        return sp
+
+    def __exit__(self, exc_type, exc, tb):
+        sp = self._sp
+        if sp is None:
+            return False
+        self._sp = None
+        core._CURRENT_SPAN.reset(self._tok)
+        _finish(sp, self._journal, error=exc_type is not None)
+        return False
+
+
+def _finish(sp: Span, journal: bool, error: bool = False) -> None:
+    global _finished_total
+    sp.dur = time.monotonic() - sp._t0
+    with core._LOCK:
+        parent = sp.parent
+        if parent is not None and parent.dur is None:
+            # parent still open on this stack: roll this span's time and
+            # byte totals (own + descendants) up one level
+            parent.child_s += sp.dur
+            parent.child_bytes += sp.bytes + sp.child_bytes
+        if journal:
+            _finished.append(sp.to_dict())
+        _finished_total += 1
+        st = _stats.get(sp.name)
+        if st is None:
+            _stats[sp.name] = {"count": 1, "total_s": sp.dur,
+                               "self_s": sp.self_s, "bytes": sp.bytes,
+                               "child_bytes": sp.child_bytes}
+        else:
+            st["count"] += 1
+            st["total_s"] += sp.dur
+            st["self_s"] += sp.self_s
+            st["bytes"] += sp.bytes
+            st["child_bytes"] += sp.child_bytes
+    if journal:
+        # the journal only sees journaled spans, so its parent link must
+        # skip aggregate-only ancestors or offline tools dangle; bytes
+        # carry the child rollup too — descendant comm may have landed on
+        # aggregate-only children that never reach the journal
+        parent = sp.parent
+        while parent is not None and not parent.journaled:
+            parent = parent.parent
+        fields = {"span_id": sp.span_id,
+                  "parent_id": parent.span_id if parent is not None else None,
+                  "start": round(sp.start, 6), "dur": round(sp.dur, 6),
+                  "bytes": sp.bytes, "child_bytes": sp.child_bytes,
+                  "tid": sp.tid, "tname": sp.tname}
+        if sp.labels:
+            fields["labels"] = sp.labels
+        if error:
+            fields["error"] = True
+        core.event("span", sp.name, **fields)
+
+
+def traced(fn=None, *, name: str | None = None, _journal: bool = True,
+           **labels):
+    """Decorator running the function body inside a span.
+
+    Bare (``@traced``) the span is named after the function's qualname;
+    ``@traced(name="matmul", grid="2x2")`` overrides name and attaches
+    labels.  Disabled telemetry short-circuits to a direct call.
+    """
+    def deco(f):
+        sname = name or f.__qualname__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            if not core._ENABLED:
+                return f(*args, **kwargs)
+            with span(sname, _journal=_journal, **labels):
+                return f(*args, **kwargs)
+        return wrapper
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def current_span() -> Span | None:
+    """The innermost open span on this thread/context, or None."""
+    return core._CURRENT_SPAN.get()
+
+
+def current_span_id() -> int | None:
+    sp = core._CURRENT_SPAN.get()
+    return sp.span_id if sp is not None else None
+
+
+def spans(name: str | None = None) -> list[dict]:
+    """Snapshot of finished spans (most recent ``_SPAN_BUFFER_MAX``),
+    optionally filtered by name.  Aggregate-only spans
+    (``_journal=False``) are not buffered — see :func:`span_stats` for
+    the complete per-name totals."""
+    with core._LOCK:
+        out = list(_finished)
+    if name is None:
+        return out
+    return [s for s in out if s["name"] == name]
+
+
+def span_stats() -> dict[str, dict]:
+    """Per-name aggregates over every finished span: count, total wall
+    time, self time (total minus directly-nested child time), own comm
+    bytes, and rolled-up child bytes."""
+    with core._LOCK:
+        return {k: dict(v) for k, v in _stats.items()}
+
+
+def _report_section(top_n: int = 10) -> dict:
+    """The ``"spans"`` section of :func:`core.report`: per-name rollups
+    plus top-N rankings by self-time and by total-time."""
+    with core._LOCK:
+        by_name = {k: dict(v) for k, v in _stats.items()}
+        finished = _finished_total
+    def _round(d):
+        return {**d, "total_s": round(d["total_s"], 6),
+                "self_s": round(d["self_s"], 6)}
+    return {
+        "finished": finished,
+        "by_name": {k: _round(v) for k, v in sorted(by_name.items())},
+        "top_by_self_s": [
+            [k, round(v["self_s"], 6)] for k, v in sorted(
+                by_name.items(), key=lambda kv: -kv[1]["self_s"])[:top_n]],
+        "top_by_total_s": [
+            [k, round(v["total_s"], 6)] for k, v in sorted(
+                by_name.items(), key=lambda kv: -kv[1]["total_s"])[:top_n]],
+    }
+
+
+def _reset() -> None:
+    global _finished_total
+    with core._LOCK:
+        _finished.clear()
+        _stats.clear()
+        _finished_total = 0
+
+
+core.register_report_section("spans", _report_section)
+core.register_reset_hook(_reset)

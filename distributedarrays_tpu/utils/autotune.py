@@ -202,23 +202,25 @@ def sweep(kernel: str, key: str, candidates: Iterable,
     results: dict[Any, float] = {}
     last_exc = None
     best = None
-    for cfg in candidates:
-        try:
-            results[cfg] = float(timer(cfg))
-        except Exception as e:  # invalid tiling / VMEM overflow / ...
-            last_exc = e
-            continue
-        if best is None or results[cfg] < results[best]:
-            best = cfg
-            if record_best:
-                record(kernel, key, best)
-                if persist:
-                    save_default()
-    if not results:
-        raise last_exc if last_exc is not None else \
-            ValueError("sweep got no candidates")
-    _tm.count("autotune.sweeps", kernel=kernel)
-    _tm.event("autotune", "sweep", kernel=kernel, key=key,
-              candidates=len(results), best=best,
-              best_s=results[best])
+    with _tm.span("autotune.sweep", kernel=kernel):
+        for cfg in candidates:
+            try:
+                with _tm.span("autotune.candidate", _journal=False):
+                    results[cfg] = float(timer(cfg))
+            except Exception as e:  # invalid tiling / VMEM overflow / ...
+                last_exc = e
+                continue
+            if best is None or results[cfg] < results[best]:
+                best = cfg
+                if record_best:
+                    record(kernel, key, best)
+                    if persist:
+                        save_default()
+        if not results:
+            raise last_exc if last_exc is not None else \
+                ValueError("sweep got no candidates")
+        _tm.count("autotune.sweeps", kernel=kernel)
+        _tm.event("autotune", "sweep", kernel=kernel, key=key,
+                  candidates=len(results), best=best,
+                  best_s=results[best])
     return best, results

@@ -179,14 +179,17 @@ def save(path: str | os.PathLike, tree: Any, store: str = "npz") -> None:
     if store not in ("npz", "orbax"):
         # validate before any side effect (no stray directories/encodes)
         raise ValueError(f"unknown store {store!r} (use 'npz' or 'orbax')")
-    _tm.event("checkpoint", "save_start", path=str(path), store=store)
-    arrays: dict[str, np.ndarray] = {}
-    meta = _encode(tree, arrays)
-    _write_store(Path(path), meta, arrays, store)
-    _tm.count("checkpoint.saves")
-    _tm.event("checkpoint", "save_end", path=str(path), store=store,
-              arrays=len(arrays),
-              bytes=int(sum(a.nbytes for a in arrays.values())))
+    with _tm.span("checkpoint.save", store=store):
+        _tm.event("checkpoint", "save_start", path=str(path), store=store)
+        arrays: dict[str, np.ndarray] = {}
+        with _tm.span("checkpoint.save.encode", _journal=False):
+            meta = _encode(tree, arrays)
+        with _tm.span("checkpoint.save.write", _journal=False):
+            _write_store(Path(path), meta, arrays, store)
+        _tm.count("checkpoint.saves")
+        _tm.event("checkpoint", "save_end", path=str(path), store=store,
+                  arrays=len(arrays),
+                  bytes=int(sum(a.nbytes for a in arrays.values())))
 
 
 def load(path: str | os.PathLike) -> Any:
@@ -194,30 +197,34 @@ def load(path: str | os.PathLike) -> Any:
     their saved chunk grids (default relayout with a warning when fewer
     devices are available than at save time)."""
     path = Path(path)
-    _tm.event("checkpoint", "restore_start", path=str(path))
-    meta_doc = json.loads((path / _META).read_text())
-    # positive new-format detection: the sentinel key can never be produced
-    # by _encode (user dicts containing it are item-pair encoded)
-    if isinstance(meta_doc, dict) and "__dartpu_store__" in meta_doc:
-        store, meta = meta_doc["__dartpu_store__"], meta_doc["tree"]
-    else:                                  # pre-store-field checkpoints
-        store, meta = "npz", meta_doc
-    if store == "orbax":
-        if (path / _ORBAX).exists():
-            import orbax.checkpoint as ocp
-            with ocp.PyTreeCheckpointer() as ckptr:
-                arrays = ckptr.restore((path / _ORBAX).resolve())
-        else:                              # array-free checkpoint
-            arrays = {}
-    else:
-        with np.load(path / _ARRS) as z:
-            arrays = {k: z[k] for k in z.files}
-    out = _decode(meta, arrays)
-    _tm.count("checkpoint.restores")
-    _tm.event("checkpoint", "restore_end", path=str(path), store=store,
-              arrays=len(arrays),
-              bytes=int(sum(a.nbytes for a in arrays.values())))
-    return out
+    with _tm.span("checkpoint.restore"):
+        _tm.event("checkpoint", "restore_start", path=str(path))
+        meta_doc = json.loads((path / _META).read_text())
+        # positive new-format detection: the sentinel key can never be
+        # produced by _encode (user dicts containing it are item-pair
+        # encoded)
+        if isinstance(meta_doc, dict) and "__dartpu_store__" in meta_doc:
+            store, meta = meta_doc["__dartpu_store__"], meta_doc["tree"]
+        else:                                  # pre-store-field checkpoints
+            store, meta = "npz", meta_doc
+        with _tm.span("checkpoint.restore.read", _journal=False):
+            if store == "orbax":
+                if (path / _ORBAX).exists():
+                    import orbax.checkpoint as ocp
+                    with ocp.PyTreeCheckpointer() as ckptr:
+                        arrays = ckptr.restore((path / _ORBAX).resolve())
+                else:                          # array-free checkpoint
+                    arrays = {}
+            else:
+                with np.load(path / _ARRS) as z:
+                    arrays = {k: z[k] for k in z.files}
+        with _tm.span("checkpoint.restore.decode", _journal=False):
+            out = _decode(meta, arrays)
+        _tm.count("checkpoint.restores")
+        _tm.event("checkpoint", "restore_end", path=str(path), store=store,
+                  arrays=len(arrays),
+                  bytes=int(sum(a.nbytes for a in arrays.values())))
+        return out
 
 
 def _write_store(path: Path, meta, arrays, store: str) -> None:

@@ -12,11 +12,15 @@ framework's terms:
   marginal-cost support (see bench.py for the tunnel caveat).
 
 Framework-level accounting (byte counts, reshard/fallback/retrace
-counters, the event journal) lives in ``distributedarrays_tpu.telemetry``
-— this module is the deep-dive tier on top: ``OpTimer`` publishes its
-spans into telemetry histograms (``optimer.<name>``), and profiler
-captures are journaled so a telemetry report names the trace directories
-that cover it.
+counters, the event journal, hierarchical spans) lives in
+``distributedarrays_tpu.telemetry`` — this module is the deep-dive tier
+on top, and both hooks are REBASED on telemetry spans: ``annotate(name)``
+opens one telemetry span AND one ``jax.profiler.TraceAnnotation``, so a
+single annotation shows the phase on the XLA/Perfetto profile timeline
+and in the framework journal (with comm-byte attribution); ``OpTimer``
+times through the same span machinery (keeping its local totals and the
+``optimer.<name>`` histograms).  Profiler captures are journaled so a
+telemetry report names the trace directories that cover it.
 """
 
 from __future__ import annotations
@@ -47,9 +51,15 @@ def trace(log_dir: str):
         _tm.event("profile", "trace_stop", dir=str(log_dir))
 
 
+@contextlib.contextmanager
 def annotate(name: str):
-    """Named span that shows up on the profiler timeline."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named span on BOTH timelines: the XLA profiler trace
+    (``jax.profiler.TraceAnnotation``) and the framework journal (a
+    telemetry span — comm/events inside are attributed to it).  One
+    annotation, both views."""
+    with _tm.span(name, src="annotate"):
+        with jax.profiler.TraceAnnotation(name):
+            yield
 
 
 class OpTimer:
@@ -68,12 +78,16 @@ class OpTimer:
     def __call__(self, name: str):
         t0 = time.perf_counter()
         try:
-            yield
+            # a real telemetry span (not just a histogram sample): the
+            # phase nests under whatever span is open, shows up in the
+            # Perfetto export, and owns the comm bytes it causes
+            with _tm.span(name, src="optimer"):
+                yield
         finally:
             dt = time.perf_counter() - t0
             self.totals[name] += dt
             self.counts[name] += 1
-            # mirror into the process-wide registry so OpTimer spans show
+            # mirror into the process-wide registry so OpTimer totals show
             # up in telemetry.report() next to the comm/fallback counters
             _tm.observe(f"optimer.{name}", dt)
 

@@ -17,15 +17,30 @@ print('probe-ok', d[0].platform, float((x@x)[0,0]))
 " >> "$LOG" 2>&1; then
     echo "=== TUNNEL ALIVE $(date -u) — running bench ===" >> "$LOG"
     # bench self-limits 300s under the kill so it exits cleanly (rc=0)
-    # with everything banked instead of dying rc=124 mid-config
-    DAT_BENCH_BUDGET_S=2700 timeout 3000 python bench.py \
+    # with everything banked instead of dying rc=124 mid-config.
+    # The telemetry journal (spans + comm events, size-capped by
+    # DA_TPU_TELEMETRY_JOURNAL_MAX_MB, default 64 MB) makes every banked
+    # run attributable after the fact: summarize below, or
+    #   python -m distributedarrays_tpu.telemetry trace <journal> -o t.json
+    # for a Perfetto timeline of the run.
+    BENCH_JOURNAL=/root/repo/tools/bench_journal.jsonl
+    rm -f "$BENCH_JOURNAL"
+    DAT_BENCH_BUDGET_S=2700 DA_TPU_TELEMETRY_JOURNAL="$BENCH_JOURNAL" \
+        timeout 3000 python bench.py \
         > /root/repo/tools/bench_out.json 2>> "$LOG"
     rc=$?
     echo "=== bench rc=$rc $(date -u) ===" >> "$LOG"
     cat /root/repo/tools/bench_out.json >> "$LOG"
     if [ $rc -eq 0 ] && grep -q '"value"' /root/repo/tools/bench_out.json && \
        ! grep -q '"value": 0.0' /root/repo/tools/bench_out.json; then
-      echo "=== BENCH BANKED — running TPU test leg ===" >> "$LOG"
+      echo "=== BENCH BANKED — telemetry summary ===" >> "$LOG"
+      if [ -s "$BENCH_JOURNAL" ]; then
+        timeout 120 python -m distributedarrays_tpu.telemetry summarize \
+            "$BENCH_JOURNAL" >> "$LOG" 2>&1
+      else
+        echo "(no telemetry journal produced)" >> "$LOG"
+      fi
+      echo "=== running TPU test leg ===" >> "$LOG"
       DAT_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_compiled.py -q >> "$LOG" 2>&1
       echo "=== tpu tests rc=$? $(date -u) ===" >> "$LOG"
       echo "DONE" > /root/repo/tools/tpu_watch.done
