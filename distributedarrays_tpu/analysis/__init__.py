@@ -1,0 +1,31 @@
+"""Static analysis (dalint) and runtime SPMD-divergence checking.
+
+The correctness-tooling layer: the reference package gates its releases on
+Aqua.jl/ExplicitImports.jl static quality; this framework additionally has
+failure classes those tools cannot see — rank-divergent collective
+ordering (deadlock on multi-controller TPU), hidden device→host syncs
+inside jitted hot paths, unbound mesh axis names, unguarded telemetry in
+hot paths, DArray leaks in loops.  Two halves:
+
+- **dalint** (``engine``/``rules``): an AST linter with stable rule codes
+  (DAL001-DAL006), per-line ``# dalint: disable=CODE`` suppressions, and a
+  CLI — ``python -m distributedarrays_tpu.analysis lint`` or the
+  ``tools/dalint`` wrapper.  Rule catalog: ``docs/analysis.md``.
+- **divergence**: an opt-in runtime checker
+  (``DA_TPU_CHECK_DIVERGENCE=1``) that records each rank's eager
+  collective sequence under ``parallel.spmd`` and aborts with a per-rank
+  sequence diff the moment ranks diverge, instead of deadlocking.
+"""
+
+from .engine import (Finding, lint_source, lint_file, lint_paths,
+                     iter_python_files, parse_suppressions)
+from .rules import RULES, Rule
+from .divergence import (CollectiveDivergenceError, DivergenceChecker,
+                         checking, payload_signature)
+
+__all__ = [
+    "Finding", "lint_source", "lint_file", "lint_paths",
+    "iter_python_files", "parse_suppressions", "RULES", "Rule",
+    "CollectiveDivergenceError", "DivergenceChecker", "checking",
+    "payload_signature",
+]

@@ -1,0 +1,70 @@
+"""dalint CLI.
+
+    python -m distributedarrays_tpu.analysis lint [paths...]
+    python -m distributedarrays_tpu.analysis rules
+
+``lint`` exits 0 when every finding is suppressed (or none exist), 1
+otherwise — the CI / tpu_watch gate.  Default paths are the package's own
+lint surface: ``distributedarrays_tpu examples bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .engine import lint_paths
+from .rules import RULES
+
+DEFAULT_TARGETS = ["distributedarrays_tpu", "examples", "bench.py"]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distributedarrays_tpu.analysis",
+        description="dalint: framework-aware static analysis")
+    sub = parser.add_subparsers(dest="cmd")
+
+    lint = sub.add_parser("lint", help="lint files/directories")
+    lint.add_argument("paths", nargs="*", help="files or directories "
+                      "(default: distributedarrays_tpu examples bench.py)")
+    lint.add_argument("--select", default=None,
+                      help="comma-separated rule codes to run (e.g. "
+                           "DAL001,DAL005)")
+    lint.add_argument("--show-suppressed", action="store_true",
+                      help="also print findings silenced by "
+                           "`# dalint: disable=` comments")
+
+    sub.add_parser("rules", help="print the rule catalog")
+
+    args = parser.parse_args(argv)
+    if args.cmd == "rules":
+        for code, rule in sorted(RULES.items()):
+            print(f"{code} [{rule.severity}] {rule.title}")
+        return 0
+    if args.cmd != "lint":
+        parser.print_help()
+        return 2
+
+    paths = args.paths or [p for p in DEFAULT_TARGETS if Path(p).exists()]
+    if not paths:
+        # zero resolved targets must NOT read as a clean gate (e.g. the
+        # bare module invoked outside the repo root without arguments)
+        print("dalint: no lint targets found (run from the repo root or "
+              "pass explicit paths)", file=sys.stderr)
+        return 2
+    select = args.select.split(",") if args.select else None
+    findings = lint_paths(paths, select=select)
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else active
+    for f in shown:
+        print(f.format())
+    n_sup = sum(1 for f in findings if f.suppressed)
+    print(f"dalint: {len(active)} finding(s), {n_sup} suppressed, "
+          f"{len(paths)} path(s)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

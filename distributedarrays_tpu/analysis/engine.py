@@ -1,0 +1,130 @@
+"""dalint engine: parsing, suppression handling, and rule dispatch.
+
+The engine is deliberately stdlib-only (``ast`` + ``re``): linting a tree
+must not require a working JAX install, must start fast enough to run
+before every TPU bench leg (tools/tpu_watch.sh), and must be importable
+from CI without pulling the framework's device runtime.
+
+Suppression syntax (checked per physical line of the finding):
+
+    x = risky_thing()   # dalint: disable=DAL002 — gather is intentional
+
+Multiple codes separate with commas (``disable=DAL001,DAL003``).  A
+whole-file opt-out uses ``# dalint: disable-file=CODE`` on any line
+(conventionally in the module docstring area).  Everything after the code
+list is free-form justification — reviewers should expect one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Iterable, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a source position.
+
+    ``suppressed`` marks findings matched by an inline or file-level
+    ``# dalint: disable`` comment; the CLI hides them by default and they
+    never affect the exit code.
+    """
+
+    path: str
+    line: int
+    col: int
+    code: str
+    severity: str
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tail = "  (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"[{self.severity}] {self.message}{tail}")
+
+
+_DISABLE_LINE = re.compile(r"#\s*dalint:\s*disable=([A-Z0-9,\s]+)")
+_DISABLE_FILE = re.compile(r"#\s*dalint:\s*disable-file=([A-Z0-9,\s]+)")
+
+
+def _codes(group: str) -> set[str]:
+    return {c.strip() for c in group.split(",") if c.strip()}
+
+
+def parse_suppressions(lines: Sequence[str]) -> tuple[dict, set]:
+    """Per-line and file-level suppression sets from raw source lines."""
+    per_line: dict[int, set[str]] = {}
+    whole_file: set[str] = set()
+    for lineno, text in enumerate(lines, 1):
+        m = _DISABLE_FILE.search(text)
+        if m:
+            whole_file |= _codes(m.group(1))
+            continue
+        m = _DISABLE_LINE.search(text)
+        if m:
+            per_line.setdefault(lineno, set()).update(_codes(m.group(1)))
+    return per_line, whole_file
+
+
+def lint_source(src: str, path: str = "<string>",
+                select: Iterable[str] | None = None) -> list[Finding]:
+    """Lint one source string; returns ALL findings, suppressed ones
+    flagged (callers filter on ``.suppressed``)."""
+    from . import rules  # late import: rules imports Finding from here
+
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 1, e.offset or 0, "DAL000",
+                        "error", f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    per_line, whole_file = parse_suppressions(lines)
+    wanted = set(select) if select is not None else None
+    out: list[Finding] = []
+    for code, rule in rules.RULES.items():
+        if wanted is not None and code not in wanted:
+            continue
+        for line, col, message in rule.check(tree, path, lines):
+            suppressed = (code in whole_file
+                          or code in per_line.get(line, ()))
+            out.append(Finding(path, line, col, code, rule.severity,
+                               message, suppressed))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def lint_file(path: str | Path,
+              select: Iterable[str] | None = None) -> list[Finding]:
+    p = Path(path)
+    try:
+        src = p.read_text()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding(str(p), 1, 0, "DAL000", "error",
+                        f"unreadable file: {e}")]
+    return lint_source(src, str(p), select)
+
+
+def iter_python_files(paths: Iterable[str | Path]) -> list[Path]:
+    """Expand files/directories into a sorted, de-duplicated .py file list."""
+    seen: dict[Path, None] = {}
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                seen.setdefault(f, None)
+        else:
+            seen.setdefault(p, None)
+    return list(seen)
+
+
+def lint_paths(paths: Iterable[str | Path],
+               select: Iterable[str] | None = None) -> list[Finding]:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    out: list[Finding] = []
+    for f in iter_python_files(paths):
+        out.extend(lint_file(f, select))
+    return out

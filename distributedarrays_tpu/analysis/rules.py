@@ -1,0 +1,622 @@
+"""dalint rule catalog: framework-aware static checks DAL001-DAL006.
+
+Each rule knows the failure class it statically excludes (docs/analysis.md
+has one bad/good pair per rule):
+
+- DAL001  collective call in a rank-dependent branch — the classic SPMD
+          deadlock: on a real multi-controller TPU every rank must issue
+          the identical collective sequence, so a ``psum``/``barrier``
+          under ``if myid() == 0:`` hangs the other ranks forever.
+- DAL002  host synchronization inside a jit-traced region — ``np.asarray``
+          / ``.item()`` / ``float(arg)`` / ``gather`` on a traced value
+          either fails to trace or silently forces a device→host transfer
+          per step.
+- DAL003  telemetry ``event``/``record_comm`` with computed arguments and
+          no ``telemetry.enabled()`` guard — disabled mode must collapse to
+          one boolean check; building f-strings or calling ``nbytes_of``
+          first defeats that.
+- DAL004  collective over an axis name no enclosing mesh binds — a typo'd
+          axis only fails at trace time, deep inside shard_map.
+- DAL005  import/export hygiene — star imports and ``__all__`` entries the
+          module never defines (the Aqua.jl / ExplicitImports.jl gates).
+- DAL006  DArray constructed in a loop with no ``close()``/context
+          discipline in the loop body — each iteration's HBM lingers until
+          GC, the leak pattern the reference's finalizer tests guard.
+
+Rules are conservative by design: a rule that cannot prove its premise
+(axis bound elsewhere, value not traced, ...) stays silent.  Intentional
+violations carry ``# dalint: disable=CODE`` with a justification comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+FindingTuple = tuple[int, int, str]  # (line, col, message)
+
+
+class Rule:
+    """A registered rule: stable code, severity, and an AST check."""
+
+    def __init__(self, code: str, severity: str, title: str, check):
+        self.code = code
+        self.severity = severity
+        self.title = title
+        self._check = check
+
+    def check(self, tree: ast.Module, path: str,
+              lines: list[str]) -> Iterator[FindingTuple]:
+        return self._check(tree, path, lines)
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _rule(code: str, severity: str, title: str):
+    def deco(fn):
+        RULES[code] = Rule(code, severity, title, fn)
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.expr) -> str | None:
+    """Dotted name of an expression (``a.b.c``), or None if not a pure
+    name/attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_name(call: ast.Call) -> str | None:
+    return _dotted(call.func)
+
+
+def _last_seg(name: str | None) -> str | None:
+    return None if name is None else name.rsplit(".", 1)[-1]
+
+
+def _root_seg(name: str | None) -> str | None:
+    return None if name is None else name.split(".", 1)[0]
+
+
+def _function_scopes(tree: ast.Module):
+    """Yield (scope_node, is_module) for the module and every function."""
+    yield tree, True
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node, False
+
+
+def _body_of(scope) -> list[ast.stmt]:
+    if isinstance(scope, ast.Lambda):
+        return [ast.Expr(scope.body)]
+    return scope.body
+
+
+def _walk_same_scope(stmts):
+    """Walk statements without descending into nested function/class
+    definitions (their bodies are separate scopes)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue  # yielded (its name may matter) but not descended
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# DAL001 — collective call in a rank-dependent branch
+# ---------------------------------------------------------------------------
+
+# rank identity sources: eager (myid/current_rank) and traced
+# (axis_index/axis_rank) — either way, branching on them and issuing a
+# collective in only one arm diverges the ranks' collective sequences
+_RANK_SOURCES = {"myid", "current_rank", "axis_index", "axis_rank"}
+
+# calls that are (or compile to) collectives: every rank of the axis /
+# context must participate
+_COLLECTIVES = {
+    # jax.lax collective primitives
+    "psum", "psum_scatter", "pmax", "pmin", "pmean", "ppermute",
+    "all_gather", "all_to_all", "pbroadcast",
+    # parallel.collectives (traced helpers)
+    "pshift", "halo_exchange", "halo_exchange_2d", "pbarrier", "pbcast",
+    "pgather", "preduce", "pall_to_all",
+    # parallel.spmd_mode (eager collectives)
+    "barrier", "bcast", "scatter", "gather_spmd",
+}
+
+
+def _rank_tainted_names(scope) -> set[str]:
+    """Names assigned (anywhere in the scope, nested defs included — an
+    overapproximation that follows closures) from a rank-identity call."""
+    tainted: set[str] = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                 ast.NamedExpr)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        has_rank_src = any(
+            isinstance(n, ast.Call)
+            and _last_seg(_call_name(n)) in _RANK_SOURCES
+            for n in ast.walk(value))
+        if not has_rank_src:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    tainted.add(n.id)
+    return tainted
+
+
+def _is_rank_dependent(test: ast.expr, tainted: set[str]) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if (isinstance(n, ast.Call)
+                and _last_seg(_call_name(n)) in _RANK_SOURCES):
+            return True
+    return False
+
+
+@_rule("DAL001", "error", "collective call in a rank-dependent branch")
+def _check_dal001(tree, path, lines):
+    seen: set[tuple[int, int]] = set()
+    for scope, _is_mod in _function_scopes(tree):
+        tainted = _rank_tainted_names(scope)
+        for node in _walk_same_scope(_body_of(scope)):
+            if not isinstance(node, ast.If):
+                continue
+            if not _is_rank_dependent(node.test, tainted):
+                continue
+            for branch in (node.body, node.orelse):
+                for sub in _walk_same_scope(branch):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = _last_seg(_call_name(sub))
+                    if name in _COLLECTIVES:
+                        key = (sub.lineno, sub.col_offset)
+                        if key not in seen:
+                            seen.add(key)
+                            yield (sub.lineno, sub.col_offset,
+                                   f"collective '{name}' inside a "
+                                   f"rank-dependent branch (test at line "
+                                   f"{node.lineno}): every rank must issue "
+                                   f"the identical collective sequence or "
+                                   f"SPMD execution deadlocks")
+
+
+# ---------------------------------------------------------------------------
+# DAL002 — host synchronization inside a jit-traced region
+# ---------------------------------------------------------------------------
+
+_TRACING_WRAPPERS = {"djit", "shard_map", "run_spmd", "pallas_call"}
+_JIT_NAMES = {"jit", "jax.jit"}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    name = _dotted(dec)
+    if name is not None:
+        return (name in _JIT_NAMES or _last_seg(name) == "djit")
+    if isinstance(dec, ast.Call):
+        fname = _call_name(dec)
+        if fname in _JIT_NAMES or _last_seg(fname) == "djit":
+            return True  # @jax.jit(static_argnums=...) style
+        if _last_seg(fname) == "partial" and dec.args:
+            inner = _dotted(dec.args[0])
+            return inner in _JIT_NAMES or _last_seg(inner) == "djit"
+    return False
+
+
+def _traced_function_names(tree) -> set[str]:
+    """Names of functions handed to a tracing wrapper anywhere in the
+    module: ``jax.jit(f)``, ``djit(f)``, ``run_spmd(f, ...)``,
+    ``shard_map(f, ...)``, ``pallas_call(kernel, ...)``, including
+    ``partial(f, ...)`` first arguments."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fname = _call_name(node)
+        if not (fname in _JIT_NAMES
+                or _last_seg(fname) in _TRACING_WRAPPERS):
+            continue
+        target = node.args[0]
+        if (isinstance(target, ast.Call)
+                and _last_seg(_call_name(target)) == "partial"
+                and target.args):
+            target = target.args[0]
+        tname = _dotted(target)
+        if tname is not None:
+            names.add(_last_seg(tname))
+    return names
+
+
+_HOST_NP_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _expr_root(node: ast.expr):
+    """Root Name of an access/method chain: ``x``, ``x.shape[0]``, and
+    ``x.sum().mean()`` all root at ``x`` — so ``float(x.sum())`` on a
+    traced param is caught, not just ``float(x)``."""
+    while True:
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            break
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _scope_params(scope) -> set[str]:
+    if isinstance(scope, ast.Module):
+        return set()
+    a = scope.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)
+            } | {p.arg for p in (a.vararg, a.kwarg) if p is not None}
+
+
+@_rule("DAL002", "error", "host synchronization inside a jit-traced region")
+def _check_dal002(tree, path, lines):
+    traced_names = _traced_function_names(tree)
+    for scope, is_mod in _function_scopes(tree):
+        if is_mod or isinstance(scope, ast.Lambda):
+            continue
+        traced = (scope.name in traced_names
+                  or any(_is_jit_decorator(d) for d in scope.decorator_list))
+        if not traced:
+            continue
+        params = _scope_params(scope)
+        for node in _walk_same_scope(scope.body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            last = _last_seg(name)
+            if last == "item" and isinstance(node.func, ast.Attribute):
+                yield (node.lineno, node.col_offset,
+                       ".item() inside a traced region forces a "
+                       "device→host sync (or fails to trace); keep the "
+                       "value on device or move the read outside jit")
+            elif (name in _HOST_NP_CALLS and node.args
+                    and _expr_root(node.args[0]) in params):
+                yield (node.lineno, node.col_offset,
+                       f"{name}(...) on a traced argument materializes it "
+                       f"on host inside the jitted region; use jnp or "
+                       f"hoist the conversion out of the traced function")
+            elif (name in ("float", "int") and node.args
+                    and _expr_root(node.args[0]) in params):
+                yield (node.lineno, node.col_offset,
+                       f"{name}(...) on a traced argument concretizes it "
+                       f"(host sync / ConcretizationTypeError); use "
+                       f"jnp.asarray / .astype instead")
+            elif (last == "gather"
+                    and (name == "gather" or _root_seg(name) == "dat"
+                         or (name or "").endswith("darray.gather"))):
+                yield (node.lineno, node.col_offset,
+                       "gather() collects the global array to host — "
+                       "never inside a jit-traced region")
+            elif last == "set_localpart":
+                yield (node.lineno, node.col_offset,
+                       "set_localpart() mutates host-side chunk state; "
+                       "inside a traced region the write does not fold "
+                       "into the compiled program — return the new value "
+                       "instead")
+
+
+# ---------------------------------------------------------------------------
+# DAL003 — unguarded telemetry call with computed arguments
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_ROOTS = {"telemetry", "_tm", "tm"}
+_GUARD_NEEDED = {"event", "record_comm"}
+
+
+def _has_enabled_guard(test: ast.expr) -> bool:
+    return any(isinstance(n, ast.Call)
+               and _last_seg(_call_name(n)) == "enabled"
+               for n in ast.walk(test))
+
+
+def _computed(arg: ast.expr) -> bool:
+    return any(isinstance(n, (ast.Call, ast.JoinedStr, ast.BinOp,
+                              ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp))
+               for n in ast.walk(arg))
+
+
+def _walk_expr(e: ast.expr):
+    """Walk an expression without descending into lambda bodies (those run
+    later, in their own guard context)."""
+    stack = [e]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@_rule("DAL003", "warning",
+       "telemetry event/record_comm with computed args, no enabled() guard")
+def _check_dal003(tree, path, lines):
+    findings: list[FindingTuple] = []
+
+    def scan_expr(e, guarded):
+        if guarded or e is None:
+            return
+        for sub in _walk_expr(e):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_name(sub)
+            if (_last_seg(name) in _GUARD_NEEDED
+                    and _root_seg(name) in _TELEMETRY_ROOTS
+                    and any(_computed(a) for a in
+                            list(sub.args)
+                            + [k.value for k in sub.keywords])):
+                findings.append((
+                    sub.lineno, sub.col_offset,
+                    f"telemetry.{_last_seg(name)} argument work "
+                    f"(f-string / call / arithmetic) runs even with "
+                    f"telemetry disabled; wrap the call in "
+                    f"`if telemetry.enabled():`"))
+
+    def visit(stmts, guarded):
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                visit(node.body, False)
+                continue
+            if isinstance(node, ast.If):
+                scan_expr(node.test, guarded)
+                visit(node.body, guarded or _has_enabled_guard(node.test))
+                visit(node.orelse, guarded)
+                continue
+            # generic compound/simple statement: scan header expressions
+            # in the current guard context, recurse into statement lists
+            for _field, value in ast.iter_fields(node):
+                if isinstance(value, ast.expr):
+                    scan_expr(value, guarded)
+                elif isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        visit(value, guarded)
+                    else:
+                        for v in value:
+                            if isinstance(v, ast.expr):
+                                scan_expr(v, guarded)
+                            elif isinstance(v, ast.ExceptHandler):
+                                visit(v.body, guarded)
+                            elif isinstance(v, ast.withitem):
+                                scan_expr(v.context_expr, guarded)
+
+    visit(tree.body, False)
+    seen: set[tuple[int, int]] = set()
+    for f in findings:
+        if (f[0], f[1]) not in seen:
+            seen.add((f[0], f[1]))
+            yield f
+
+
+# ---------------------------------------------------------------------------
+# DAL004 — collective axis name not bound by any enclosing mesh
+# ---------------------------------------------------------------------------
+
+# only the collectives that actually take a mesh-axis argument: the eager
+# spmd_mode collectives (barrier/bcast/scatter/gather_spmd) are axis-less
+# — their first string positional is a payload or tag, not an axis
+_AXIS_TAKERS = {
+    "psum", "psum_scatter", "pmax", "pmin", "pmean", "ppermute",
+    "all_gather", "all_to_all", "pbroadcast",
+    "pshift", "halo_exchange", "halo_exchange_2d", "pbarrier", "pbcast",
+    "pgather", "preduce", "pall_to_all",
+    "axis_index", "axis_size", "axis_rank",
+}
+_DN_AXIS = re.compile(r"^d\d+$")
+
+
+def _literal_axis_names(call: ast.Call) -> tuple[set[str], bool]:
+    """Axis names a mesh-building call binds; (names, known).  ``known``
+    False means the binding could not be determined statically."""
+    name = _last_seg(_call_name(call))
+    if name == "Mesh":
+        cands = list(call.args[1:2]) + [
+            k.value for k in call.keywords if k.arg == "axis_names"]
+        for c in cands:
+            if isinstance(c, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in c.elts):
+                return {e.value for e in c.elts}, True
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                return {c.value}, True
+        return set(), False
+    if name == "spmd_mesh":
+        for k in call.keywords:
+            if k.arg == "axis":
+                if (isinstance(k.value, ast.Constant)
+                        and isinstance(k.value.value, str)):
+                    return {k.value.value, "d0"}, True
+                return set(), False
+        if len(call.args) >= 2:
+            a = call.args[1]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return {a.value, "d0"}, True
+            return set(), False
+        return {"p", "d0"}, True  # spmd_mesh default axis
+    if name in ("mesh_for", "make_mesh"):
+        # binds the d0/d1/... family (layout.mesh_for) or unknown names
+        return set(), name == "mesh_for"
+    return set(), True
+
+
+def _call_axis_literals(call: ast.Call) -> list[str]:
+    """String axis names this collective call references: the first
+    positional string constant (the axis slot in every collective API
+    here) plus any axis=/axes= keyword literals."""
+    out: list[str] = []
+    for a in call.args:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            out.append(a.value)
+            break
+    for k in call.keywords:
+        if k.arg in ("axis", "axes", "axis_name"):
+            if (isinstance(k.value, ast.Constant)
+                    and isinstance(k.value.value, str)):
+                out.append(k.value.value)
+            elif isinstance(k.value, (ast.Tuple, ast.List)):
+                out.extend(e.value for e in k.value.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+    return out
+
+
+@_rule("DAL004", "error", "collective axis name unbound by enclosing mesh")
+def _check_dal004(tree, path, lines):
+    for scope, _is_mod in _function_scopes(tree):
+        bound: set[str] = set()
+        allow_dn = False
+        known = True
+        saw_mesh = False
+        for node in _walk_same_scope(_body_of(scope)):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_seg(_call_name(node))
+            if name in ("Mesh", "spmd_mesh", "mesh_for", "make_mesh"):
+                saw_mesh = True
+                names, ok = _literal_axis_names(node)
+                bound |= names
+                known = known and ok
+                if name in ("mesh_for",):
+                    allow_dn = True
+        if not saw_mesh or not known:
+            continue  # axis bound by the caller / not statically decidable
+        for node in _walk_same_scope(_body_of(scope)):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_seg(_call_name(node)) not in _AXIS_TAKERS:
+                continue
+            for axis in _call_axis_literals(node):
+                if axis in bound or (allow_dn and _DN_AXIS.match(axis)):
+                    continue
+                yield (node.lineno, node.col_offset,
+                       f"axis {axis!r} is not bound by any mesh built in "
+                       f"this scope (bound: {sorted(bound)}); a mismatched "
+                       f"axis name only fails at trace time inside "
+                       f"shard_map")
+
+
+# ---------------------------------------------------------------------------
+# DAL005 — import/export hygiene (star imports, phantom __all__ entries)
+# ---------------------------------------------------------------------------
+
+
+def _module_bindings(tree: ast.Module) -> set[str]:
+    """Names bound at module level (descending into if/try/with/loop
+    blocks but not into function or class bodies)."""
+    bound: set[str] = set()
+    for node in _walk_same_scope(tree.body):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                bound.add(a.asname or a.name.split(".", 1)[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    bound.add(a.asname or a.name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            # covers plain/ann/aug assigns, loop targets, with-as, walrus
+            bound.add(node.id)
+    return bound
+
+
+@_rule("DAL005", "error", "import/export hygiene")
+def _check_dal005(tree, path, lines):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+                a.name == "*" for a in node.names):
+            yield (node.lineno, node.col_offset,
+                   f"star import from {node.module!r}: explicit imports "
+                   f"only (ExplicitImports discipline)")
+    # __all__ must be a literal list/tuple of strings naming real bindings
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)):
+            continue
+        if not isinstance(node.value, (ast.List, ast.Tuple)):
+            continue  # dynamically built __all__: out of scope
+        names = [e.value for e in node.value.elts
+                 if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+        bound = _module_bindings(tree)
+        seen: set[str] = set()
+        for name in names:
+            if name in seen:
+                yield (node.lineno, node.col_offset,
+                       f"__all__ lists {name!r} twice")
+            seen.add(name)
+            if name not in bound:
+                yield (node.lineno, node.col_offset,
+                       f"__all__ exports {name!r} but the module never "
+                       f"binds it")
+
+
+# ---------------------------------------------------------------------------
+# DAL006 — DArray constructed in a loop without close()/context discipline
+# ---------------------------------------------------------------------------
+
+_DARRAY_CTORS = {
+    "dzeros", "dones", "dfill", "drand", "drandn", "drandint", "dsample",
+    "darray", "darray_like", "dfromfunction", "distribute", "from_chunks",
+    "ddata", "ddata_bcoo",
+}
+_CLOSERS = {"close", "d_closeall", "close_context"}
+
+
+@_rule("DAL006", "warning",
+       "DArray created in a loop without close()/context discipline")
+def _check_dal006(tree, path, lines):
+    seen: set[tuple[int, int]] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        body = list(node.body)
+        has_closer = any(
+            isinstance(sub, ast.Call)
+            and _last_seg(_call_name(sub)) in _CLOSERS
+            for sub in _walk_same_scope(body))
+        if has_closer:
+            continue
+        for sub in _walk_same_scope(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _last_seg(_call_name(sub))
+            if name in _DARRAY_CTORS:
+                key = (sub.lineno, sub.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield (sub.lineno, sub.col_offset,
+                       f"'{name}' allocates a DArray every iteration and "
+                       f"the loop body never close()s one — per-iteration "
+                       f"HBM lingers until GC (leak-prone; see "
+                       f"core.d_closeall / DArray.close)")

@@ -125,7 +125,9 @@ def _resharder(sharding):
     # sharding — which is exactly the "new program" signal the journal's
     # jit category tracks
     _tm.count("jit.builds", fn="resharder")
-    _tm.event("jit", "build", fn="resharder", to=str(sharding))
+    # cold path: lru-miss body, once per distinct target sharding
+    _tm.event("jit", "build", fn="resharder",  # dalint: disable=DAL003
+              to=str(sharding))
     return jax.jit(lambda x: x, out_shardings=sharding)
 
 
@@ -254,13 +256,15 @@ class DArray:
             if tuple(data.shape) == pdims:
                 if getattr(data, "sharding", psh) != psh:
                     with _tm.span("reshard", op="padded_relayout"):
-                        _tm.record_comm("reshard", _tm.nbytes_of(data),
-                                        op="padded_relayout")
+                        if _tm.enabled():
+                            _tm.record_comm("reshard", _tm.nbytes_of(data),
+                                            op="padded_relayout")
                         data = jax.device_put(data, psh)
             elif tuple(data.shape) == dims:
                 with _tm.span("reshard", op="blocked_pad"):
-                    _tm.record_comm("reshard", _tm.nbytes_of(data),
-                                    op="blocked_pad")
+                    if _tm.enabled():
+                        _tm.record_comm("reshard", _tm.nbytes_of(data),
+                                        op="blocked_pad")
                     data = _blocked_pad_jit(_cuts_key(cuts), psh)(data)
             else:
                 raise ValueError(f"data shape {tuple(data.shape)} matches "
@@ -968,8 +972,9 @@ def _put_global_impl(host, sharding) -> jax.Array:
         # every owning process participates — then fall through to the
         # host-scatter path with the local replica every process now holds
         from jax.sharding import NamedSharding, PartitionSpec
-        _tm.record_comm("replicate", _tm.nbytes_of(host),
-                        op="put_global", shape=list(host.shape))
+        if _tm.enabled():
+            _tm.record_comm("replicate", _tm.nbytes_of(host),
+                            op="put_global", shape=list(host.shape))
         rep = _resharder(NamedSharding(
             host.sharding.mesh, PartitionSpec()))(host)
         host = np.asarray(rep.addressable_data(0))

@@ -52,7 +52,8 @@ def _jitted(fn: Callable, out_sharding):
     # (a fresh lambda per call defeats this cache AND the XLA cache; the
     # counter makes that pathology visible)
     _tm.count("jit.builds", fn="elementwise")
-    _tm.event("jit", "build", fn=getattr(fn, "__name__", str(fn)),
+    # cold path: lru-miss body, once per distinct (fn, sharding)
+    _tm.event("jit", "build", fn=getattr(fn, "__name__", str(fn)),  # dalint: disable=DAL003
               once_key=f"jit:elementwise:{getattr(fn, '__name__', fn)!s}")
     if out_sharding is None:
         return jax.jit(fn)

@@ -73,7 +73,8 @@ def run_spmd(f: Callable, mesh: Mesh, in_specs, out_specs,
     collectives from this module communicate over the mesh axes.
     """
     _tm.count("op.run_spmd")
-    _tm.event("jit", "build", fn="run_spmd",
+    # cold path: program construction, not the per-step execution
+    _tm.event("jit", "build", fn="run_spmd",  # dalint: disable=DAL003
               once_key=f"run_spmd:{getattr(f, '__name__', f)!s}:"
                        f"{tuple(mesh.shape.items())}")
     return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,

@@ -110,8 +110,9 @@ def gather_global(d) -> np.ndarray:
         return np.asarray(arr)
     # cross-host gather: every non-owning process receives the full array
     # over DCN (replication program and/or host-level allgather)
-    _tm.record_comm("multihost_gather", _tm.nbytes_of(arr),
-                    op="gather_global", shape=list(np.shape(arr)))
+    if _tm.enabled():
+        _tm.record_comm("multihost_gather", _tm.nbytes_of(arr),
+                        op="gather_global", shape=list(np.shape(arr)))
     procs_of = sorted({dev.process_index for dev in arr.sharding.device_set})
     me = jax.process_index()
     if len(procs_of) > 1:

@@ -37,6 +37,7 @@ import numpy as np
 from .. import core
 from .. import layout as L
 from .. import telemetry as _tm
+from ..analysis import divergence as _dv
 
 __all__ = [
     "spmd", "sendto", "recvfrom", "recvfrom_any", "barrier", "bcast",
@@ -113,6 +114,9 @@ class SPMDContext:
         self._failed = threading.Event()
         self._release_gen = 0
         self._proc_state = None   # process backend's persistent queues
+        # per-run collective-divergence checker (DA_TPU_CHECK_DIVERGENCE=1,
+        # thread backend); installed/cleared by spmd()
+        self._divergence = None
 
     def mailbox(self, pid: int) -> _Mailbox:
         try:
@@ -238,10 +242,22 @@ def recvfrom_any(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
 # ---------------------------------------------------------------------------
 
 
+def _dv_note(ctx, rank: int, op: str, detail: str) -> None:
+    """Record an eager collective with the run's divergence checker (no-op
+    unless DA_TPU_CHECK_DIVERGENCE armed this run).  Raises
+    CollectiveDivergenceError in the issuing rank's task on mismatch.
+    getattr: the process backend's _RunContext duck-types SPMDContext and
+    is never instrumented (checking is thread-backend only)."""
+    ck = getattr(ctx, "_divergence", None)
+    if ck is not None:
+        ck.record(rank, op, detail)
+
+
 def barrier(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
     """All-to-all barrier with double-barrier protection via per-rank
     generation counters (reference barrier, spmd.jl:159-184)."""
     ctx, rank = _current()
+    _dv_note(ctx, rank, "barrier", f"tag={tag!r}")
     _tm.count("spmd.barrier")
     gen = ctx._barrier_gen[rank]
     ctx._barrier_gen[rank] = gen + 1
@@ -265,6 +281,9 @@ def bcast(data: Any, root: int, tag: Any = None,
     spmd.jl:186-196)."""
     ctx, rank = _current()
     _check_root(ctx, root)
+    # payload signature excluded: only root's data participates (non-root
+    # ranks conventionally pass None), so shapes legitimately differ
+    _dv_note(ctx, rank, "bcast", f"root={root}, tag={tag!r}")
     btag = ("bcast", tag)
     if rank == root:
         if _tm.enabled():
@@ -288,6 +307,7 @@ def scatter(x, root: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
     ``@assert rem(length(x), length(pids)) == 0``)."""
     ctx, rank = _current()
     _check_root(ctx, root)
+    _dv_note(ctx, rank, "scatter", f"root={root}, tag={tag!r}")
     stag = ("scatter", tag)
     if rank == root:
         n = len(x)
@@ -318,6 +338,9 @@ def gather_spmd(x, root: int, tag: Any = None,
     spmd.jl:214-231).  Returns the list on root, None elsewhere."""
     ctx, rank = _current()
     _check_root(ctx, root)
+    _dv_note(ctx, rank, "gather_spmd",
+             f"root={root}, tag={tag!r}, "
+             f"payload={_dv.payload_signature(x)}")
     gtag = ("gather", tag)
     if rank != root:
         if _tm.enabled():
@@ -367,8 +390,21 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
     if pids is not None and not implicit and list(pids) != ctx.pids:
         raise ValueError("pids disagree with explicit context's pids")
     _tm.count("spmd.runs", backend=backend)
-    _tm.event("spmd", "run", backend=backend, ranks=len(ctx.pids),
-              once_key=f"spmd:run:{backend}:{len(ctx.pids)}")
+    if _tm.enabled():
+        _tm.event("spmd", "run", backend=backend, ranks=len(ctx.pids),
+                  once_key=f"spmd:run:{backend}:{len(ctx.pids)}")
+    checker = None
+    if _dv.checking():
+        if backend == "thread":
+            checker = _dv.DivergenceChecker(ctx.pids,
+                                            on_mismatch=ctx._failed.set)
+        else:
+            from ..utils.debug import warn_once
+            warn_once("divergence:process-backend",
+                      "DA_TPU_CHECK_DIVERGENCE is set but the process "
+                      "backend is not instrumented; collective-divergence "
+                      "checking only covers backend='thread'")
+    ctx._divergence = checker
     if backend == "process":
         from .spmd_process import run_spmd_process
         try:
@@ -396,6 +432,11 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
             # track per rank thread)
             with _tm.span("spmd.step", rank=rank):
                 results[rank] = f(*args)
+            if checker is not None:
+                # clean completion: peers mid-collective beyond this rank's
+                # final count can never be matched — fail fast, don't let
+                # them wait out the receive timeout
+                checker.finish(rank)
         except BaseException as e:  # noqa: BLE001 — propagated to caller
             errors[rank] = e
             ctx._failed.set()
@@ -420,6 +461,7 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
                 raise TimeoutError(
                     f"spmd task {t.name} did not finish in {timeout}s")
     finally:
+        ctx._divergence = None
         if implicit:
             ctx.close()
         elif errors or any(t.is_alive() for t in threads):
@@ -427,6 +469,19 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
             # barrier generations so the explicit context stays usable
             ctx._reset_comm()
     if errors:
+        def _secondary(e):
+            # failures that are consequences, not causes: peer aborts,
+            # receive timeouts, and the divergence error itself
+            return ((isinstance(e, RuntimeError)
+                     and "peer task failed" in str(e))
+                    or isinstance(e, (TimeoutError,
+                                      _dv.CollectiveDivergenceError)))
+        if (checker is not None and checker.error is not None
+                and all(_secondary(e) for e in errors.values())):
+            # the divergence IS the root cause: every other failure is a
+            # peer abort/timeout it triggered.  Raise it directly so the
+            # per-rank sequence diff reaches the caller unwrapped.
+            raise checker.error
         # prefer the root-cause failure over secondary "peer failed" aborts
         primary = [(r, e) for r, e in sorted(errors.items())
                    if not (isinstance(e, RuntimeError)
@@ -435,4 +490,6 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
         raise RuntimeError(
             f"spmd task on rank {rank} failed ({len(errors)} total failures)"
         ) from err
+    if checker is not None:
+        checker.verify()   # backstop: identical sequences end to end
     return [results[p] for p in ctx.pids]

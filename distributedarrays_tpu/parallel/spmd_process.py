@@ -270,12 +270,13 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
         for rank, st in stores.items():
             ctx.store[rank] = st
 
-    _tm.event("spmd", "process_run", ranks=len(ctx.pids),
-              ok=len(results), failed=len(errors),
-              once_key=f"spmd:process_run:{len(ctx.pids)}")
-    _tm.record_comm("spmd_process_result",
-                    sum(_tm.nbytes_of(v) for v in results.values()),
-                    op="run_spmd_process", journal=False)
+    if _tm.enabled():
+        _tm.event("spmd", "process_run", ranks=len(ctx.pids),
+                  ok=len(results), failed=len(errors),
+                  once_key=f"spmd:process_run:{len(ctx.pids)}")
+        _tm.record_comm("spmd_process_result",
+                        sum(_tm.nbytes_of(v) for v in results.values()),
+                        op="run_spmd_process", journal=False)
 
     if errors:
         # prefer root-cause failures over structurally-marked peer aborts

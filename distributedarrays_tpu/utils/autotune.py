@@ -142,8 +142,11 @@ def get(kernel: str, key: str, default=None):
         entry = _REGISTRY.get(kernel, {}).get(key, _MISS)
     if entry is _MISS:
         _tm.count("autotune.miss", kernel=kernel)
-        _tm.event("autotune", "miss", kernel=kernel, key=key,
-                  once_key=f"autotune:miss:{kernel}:{key}")
+        # per-dispatch lookup path: the once_key f-string must not be
+        # built in disabled mode
+        if _tm.enabled():
+            _tm.event("autotune", "miss", kernel=kernel, key=key,
+                      once_key=f"autotune:miss:{kernel}:{key}")
         return default
     _tm.count("autotune.hit", kernel=kernel)
     return entry
@@ -220,7 +223,8 @@ def sweep(kernel: str, key: str, candidates: Iterable,
             raise last_exc if last_exc is not None else \
                 ValueError("sweep got no candidates")
         _tm.count("autotune.sweeps", kernel=kernel)
-        _tm.event("autotune", "sweep", kernel=kernel, key=key,
+        # cold path: a sweep spends seconds compiling/timing candidates
+        _tm.event("autotune", "sweep", kernel=kernel, key=key,  # dalint: disable=DAL003
                   candidates=len(results), best=best,
                   best_s=results[best])
     return best, results

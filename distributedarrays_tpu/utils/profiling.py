@@ -42,13 +42,14 @@ def trace(log_dir: str):
 
     View with `tensorboard --logdir <dir>` or ui.perfetto.dev.
     """
-    _tm.event("profile", "trace_start", dir=str(log_dir))
+    # cold path: bounds a whole profiler capture session
+    _tm.event("profile", "trace_start", dir=str(log_dir))  # dalint: disable=DAL003
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
-        _tm.event("profile", "trace_stop", dir=str(log_dir))
+        _tm.event("profile", "trace_stop", dir=str(log_dir))  # dalint: disable=DAL003
 
 
 @contextlib.contextmanager

@@ -1,0 +1,492 @@
+"""dalint rule engine + SPMD collective-divergence checker tests.
+
+Static half: every rule (DAL001-DAL006) must fire on its bad example and
+stay silent on the good one — the same bad/good pairs docs/analysis.md
+documents.  Runtime half: under DA_TPU_CHECK_DIVERGENCE=1 a rank-divergent
+SPMD program must abort with a per-rank collective-sequence diff (fast —
+no waiting out the receive timeout) while conforming programs pass
+unchanged on the 8-rank CPU mesh.
+"""
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from distributedarrays_tpu import telemetry
+from distributedarrays_tpu.analysis import (CollectiveDivergenceError,
+                                            DivergenceChecker, Finding,
+                                            RULES, checking, lint_paths,
+                                            lint_source)
+from distributedarrays_tpu.parallel import spmd_mode as S
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def codes(findings, *, suppressed=False):
+    return [f.code for f in findings if f.suppressed == suppressed]
+
+
+# ---------------------------------------------------------------------------
+# rule catalog sanity
+# ---------------------------------------------------------------------------
+
+
+def test_rule_catalog_complete():
+    assert set(RULES) == {f"DAL00{i}" for i in range(1, 7)}
+    for code, rule in RULES.items():
+        assert rule.severity in ("error", "warning"), code
+        assert rule.title, code
+
+
+# ---------------------------------------------------------------------------
+# DAL001 — collective in rank-dependent branch
+# ---------------------------------------------------------------------------
+
+
+def test_dal001_fires_on_rank_gated_collective():
+    src = (
+        "from distributedarrays_tpu.parallel import myid, barrier\n"
+        "def f():\n"
+        "    me = myid()\n"
+        "    if me == 0:\n"
+        "        barrier()\n")
+    assert codes(lint_source(src)) == ["DAL001"]
+
+
+def test_dal001_traced_axis_index_variant():
+    src = (
+        "from jax import lax\n"
+        "def f(x):\n"
+        "    r = lax.axis_index('p')\n"
+        "    if r == 0:\n"
+        "        return lax.psum(x, 'p')\n"
+        "    return x\n")
+    assert "DAL001" in codes(lint_source(src))
+
+
+def test_dal001_silent_on_symmetric_collectives():
+    # the correct idiom: rank-dependent *arguments*, symmetric *calls*
+    src = (
+        "from distributedarrays_tpu.parallel import myid, bcast, barrier\n"
+        "def f():\n"
+        "    me = myid()\n"
+        "    v = bcast('x' if me == 0 else None, root=0)\n"
+        "    barrier()\n"
+        "    return v\n")
+    assert codes(lint_source(src)) == []
+
+
+def test_dal001_silent_on_rank_gated_p2p():
+    # sendto/recvfrom are point-to-point: rank-dependent branching is the
+    # whole point of the dynamic SPMD mode
+    src = (
+        "from distributedarrays_tpu.parallel import myid, sendto, recvfrom\n"
+        "def f():\n"
+        "    if myid() == 0:\n"
+        "        sendto(1, 'x')\n"
+        "    else:\n"
+        "        recvfrom(0)\n")
+    assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# DAL002 — host sync in traced region
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("body,expect", [
+    ("    return np.asarray(x).sum()\n", True),      # host materialize
+    ("    return x.item()\n", True),                 # scalar sync
+    ("    return float(x)\n", True),                 # concretization
+    ("    return jnp.sum(x)\n", False),              # clean traced code
+    ("    return int(3)\n", False),                  # literal: fine
+])
+def test_dal002_jit_decorated(body, expect):
+    src = ("import jax\nimport numpy as np\nimport jax.numpy as jnp\n"
+           "@jax.jit\ndef f(x):\n" + body)
+    got = "DAL002" in codes(lint_source(src))
+    assert got is expect, body
+
+
+def test_dal002_function_passed_to_jit_and_djit():
+    src = ("import jax\n"
+           "def step(x):\n"
+           "    return x.item()\n"
+           "g = jax.jit(step)\n")
+    assert "DAL002" in codes(lint_source(src))
+    src2 = ("from distributedarrays_tpu import djit, gather\n"
+            "@djit\n"
+            "def f(d):\n"
+            "    return gather(d)\n")
+    assert "DAL002" in codes(lint_source(src2))
+
+
+def test_dal002_catches_method_chain_concretization():
+    # the docs' canonical bad example: float() on a DERIVED traced value
+    src = ("from distributedarrays_tpu import djit\n"
+           "@djit\n"
+           "def step(x):\n"
+           "    return float(x.sum())\n")
+    assert "DAL002" in codes(lint_source(src))
+
+
+def test_dal002_untraced_function_free():
+    src = ("import numpy as np\n"
+           "def host_side(x):\n"
+           "    return float(np.asarray(x).sum())\n")
+    assert codes(lint_source(src)) == []
+
+
+def test_dal002_lax_gather_not_confused():
+    # jax.lax.gather is a device op, not the host gather()
+    src = ("import jax\nfrom jax import lax\n"
+           "@jax.jit\ndef f(x, idx, dnums, ss):\n"
+           "    return lax.gather(x, idx, dnums, ss)\n")
+    assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# DAL003 — unguarded telemetry with computed args
+# ---------------------------------------------------------------------------
+
+
+def test_dal003_unguarded_vs_guarded():
+    bad = ("from distributedarrays_tpu import telemetry as _tm\n"
+           "def f(n):\n"
+           "    _tm.event('a', 'b', key=f'x{n}')\n")
+    assert codes(lint_source(bad)) == ["DAL003"]
+    good = ("from distributedarrays_tpu import telemetry as _tm\n"
+            "def f(n):\n"
+            "    if _tm.enabled():\n"
+            "        _tm.event('a', 'b', key=f'x{n}')\n")
+    assert codes(lint_source(good)) == []
+
+
+def test_dal003_guard_recognized_in_nested_statements():
+    src = ("from distributedarrays_tpu import telemetry as _tm\n"
+           "def f(n):\n"
+           "    for i in range(n):\n"
+           "        if _tm.enabled():\n"
+           "            _tm.record_comm('k', len(str(i)))\n")
+    assert codes(lint_source(src)) == []
+
+
+def test_dal003_constant_args_need_no_guard():
+    src = ("from distributedarrays_tpu import telemetry as _tm\n"
+           "def f():\n"
+           "    _tm.event('a', 'b', key='static')\n")
+    assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# DAL004 — unbound axis names
+# ---------------------------------------------------------------------------
+
+
+def test_dal004_typo_axis_caught():
+    src = ("from jax.sharding import Mesh\nfrom jax import lax\n"
+           "import numpy as np, jax\n"
+           "def f(x):\n"
+           "    mesh = Mesh(np.array(jax.devices()).reshape(8), ('p',))\n"
+           "    return lax.psum(x, 'q')\n")
+    found = [f for f in lint_source(src) if f.code == "DAL004"]
+    assert len(found) == 1 and "'q'" in found[0].message
+
+
+def test_dal004_bound_axis_and_caller_bound_axis_pass():
+    src = ("from jax.sharding import Mesh\nfrom jax import lax\n"
+           "import numpy as np, jax\n"
+           "def f(x):\n"
+           "    mesh = Mesh(np.array(jax.devices()).reshape(8), ('p',))\n"
+           "    return lax.psum(x, 'p')\n"
+           "def g(x, axis):\n"
+           "    return lax.psum(x, axis)\n"          # axis from caller
+           "def h(x):\n"
+           "    return lax.psum(x, 'anything')\n")   # no mesh in scope
+    assert codes(lint_source(src)) == []
+
+
+def test_dal004_ignores_axisless_eager_collectives():
+    # barrier/bcast/... take no axis: payload/tag strings are not axes
+    src = ("from distributedarrays_tpu.parallel import (spmd_mesh, bcast,\n"
+           "                                            barrier)\n"
+           "def f():\n"
+           "    mesh = spmd_mesh(8)\n"
+           "    barrier('sync')\n"
+           "    return bcast('go', root=0)\n")
+    assert codes(lint_source(src)) == []
+
+
+def test_dal004_spmd_mesh_default_axis():
+    src = ("from distributedarrays_tpu.parallel import spmd_mesh\n"
+           "from jax import lax\n"
+           "def f(x):\n"
+           "    mesh = spmd_mesh(8)\n"
+           "    return lax.psum(x, 'p')\n")
+    assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# DAL005 — import/export hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_dal005_star_import_and_phantom_export():
+    src = ("from os.path import *\n"
+           "__all__ = ['real', 'phantom']\n"
+           "def real():\n"
+           "    pass\n")
+    msgs = [f.message for f in lint_source(src) if f.code == "DAL005"]
+    assert len(msgs) == 2
+    assert any("star import" in m for m in msgs)
+    assert any("phantom" in m for m in msgs)
+
+
+def test_dal005_clean_module_passes():
+    src = ("import os\n"
+           "__all__ = ['x', 'f', 'C']\n"
+           "x = 1\n"
+           "def f():\n"
+           "    pass\n"
+           "class C:\n"
+           "    pass\n")
+    assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# DAL006 — DArray-in-loop leak pattern
+# ---------------------------------------------------------------------------
+
+
+def test_dal006_loop_alloc_without_close():
+    src = ("import distributedarrays_tpu as dat\n"
+           "def f():\n"
+           "    for i in range(10):\n"
+           "        d = dat.dzeros((8, 8))\n")
+    assert codes(lint_source(src)) == ["DAL006"]
+
+
+def test_dal006_close_discipline_passes():
+    src = ("import distributedarrays_tpu as dat\n"
+           "def f():\n"
+           "    for i in range(10):\n"
+           "        d = dat.dzeros((8, 8))\n"
+           "        d.close()\n"
+           "def g():\n"
+           "    d = dat.dzeros((8, 8))\n"   # not in a loop
+           "    return d\n")
+    assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_with_justification():
+    src = ("from distributedarrays_tpu.parallel import myid, barrier\n"
+           "def f():\n"
+           "    if myid() == 0:\n"
+           "        barrier()  # dalint: disable=DAL001 — test fixture\n")
+    fs = lint_source(src)
+    assert codes(fs) == [] and codes(fs, suppressed=True) == ["DAL001"]
+
+
+def test_file_level_suppression():
+    src = ("# dalint: disable-file=DAL006\n"
+           "import distributedarrays_tpu as dat\n"
+           "def f():\n"
+           "    for i in range(10):\n"
+           "        d = dat.dzeros((8, 8))\n")
+    fs = lint_source(src)
+    assert codes(fs) == [] and codes(fs, suppressed=True) == ["DAL006"]
+
+
+def test_syntax_error_reported_not_raised():
+    fs = lint_source("def broken(:\n", "bad.py")
+    assert [f.code for f in fs] == ["DAL000"]
+
+
+@pytest.mark.slow
+def test_cli_round_trip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from os.path import *\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis", "lint",
+         str(bad)], capture_output=True, text=True, cwd=str(REPO),
+        timeout=180)
+    assert r.returncode == 1 and "DAL005" in r.stdout
+    bad.write_text("from os.path import *  # dalint: disable=DAL005 — demo\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis", "lint",
+         str(bad)], capture_output=True, text=True, cwd=str(REPO),
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # no resolvable targets must not read as a clean gate
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis", "lint"],
+        capture_output=True, text=True, cwd=str(tmp_path),
+        env={**__import__("os").environ,
+             "PYTHONPATH": str(REPO)}, timeout=180)
+    assert r.returncode == 2 and "no lint targets" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# divergence checker (DA_TPU_CHECK_DIVERGENCE=1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def divergence_on(monkeypatch):
+    monkeypatch.setenv("DA_TPU_CHECK_DIVERGENCE", "1")
+    assert checking()
+
+
+def test_checking_env_gate(monkeypatch):
+    monkeypatch.delenv("DA_TPU_CHECK_DIVERGENCE", raising=False)
+    assert not checking()
+    monkeypatch.setenv("DA_TPU_CHECK_DIVERGENCE", "0")
+    assert not checking()
+    monkeypatch.setenv("DA_TPU_CHECK_DIVERGENCE", "1")
+    assert checking()
+
+
+def test_conforming_program_passes_checked(divergence_on):
+    # the full eager collective set, 8 ranks, checker armed
+    def prog():
+        me = S.myid()
+        S.barrier()
+        v = S.bcast("payload" if me == 2 else None, root=2)
+        part = S.scatter(list(range(16)) if me == 0 else None, root=0)
+        got = S.gather_spmd(me * me, root=1)
+        S.barrier(tag="end")
+        return (v, part, got)
+    out = S.spmd(prog)
+    assert all(v == "payload" for v, _, _ in out)
+
+
+def test_rank_divergent_collective_raises_with_sequences(divergence_on):
+    # the acceptance-criteria program: a collective under `if rank == 0:`
+    def bad():
+        if S.myid() == 0:
+            S.barrier()
+        return True
+    t0 = time.monotonic()
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        S.spmd(bad, pids=[0, 1])
+    msg = str(ei.value)
+    # fail fast (mismatch detection, not the 60s receive timeout)
+    assert time.monotonic() - t0 < 30
+    # both ranks' sequences are in the message
+    assert "rank 0" in msg and "rank 1" in msg
+    assert "barrier" in msg and "(none)" in msg
+
+
+def test_op_mismatch_at_same_slot(divergence_on):
+    def bad():
+        if S.myid() == 0:
+            S.barrier()
+        else:
+            S.bcast("x", root=1)
+        return True
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        S.spmd(bad, pids=[0, 1])
+    msg = str(ei.value)
+    assert "barrier" in msg and "bcast" in msg
+
+
+def test_explicit_context_usable_after_divergence(divergence_on):
+    ctx = S.context([0, 1])
+    def bad():
+        if S.myid() == 0:
+            S.barrier()
+    with pytest.raises(CollectiveDivergenceError):
+        S.spmd(bad, context=ctx)
+    # the context must be reset, not poisoned, by the aborted run
+    assert S.spmd(lambda: S.myid(), context=ctx) == [0, 1]
+    S.close_context(ctx)
+
+
+def test_genuine_error_wins_over_divergence(divergence_on):
+    # a user exception is the root cause even when sequences also diverge
+    def bad():
+        if S.myid() == 0:
+            S.barrier(timeout=30)
+        else:
+            raise ValueError("boom")
+    with pytest.raises(RuntimeError, match="rank") as ei:
+        S.spmd(bad, pids=[0, 1])
+    assert not isinstance(ei.value, CollectiveDivergenceError)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_checker_off_means_timeout_not_divergence(monkeypatch):
+    monkeypatch.delenv("DA_TPU_CHECK_DIVERGENCE", raising=False)
+    def bad():
+        if S.myid() == 0:
+            S.barrier(timeout=2)
+        return True
+    with pytest.raises(RuntimeError) as ei:
+        S.spmd(bad, pids=[0, 1])
+    assert not isinstance(ei.value, CollectiveDivergenceError)
+
+
+def test_mismatch_journaled_as_telemetry_event(divergence_on):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        def bad():
+            if S.myid() == 0:
+                S.barrier()
+            return True
+        with pytest.raises(CollectiveDivergenceError):
+            S.spmd(bad, pids=[0, 1])
+        evs = [e for e in telemetry.events()
+               if e.get("cat") == "divergence"]
+        assert evs, "mismatch must journal a divergence event"
+    finally:
+        telemetry.reset()
+
+
+def test_checker_unit_payload_signature_in_gather(divergence_on):
+    import numpy as np
+    # gather payload shape signatures must agree across ranks
+    def bad():
+        me = S.myid()
+        x = np.zeros((me + 1, 4), np.float32)   # different shape per rank
+        S.gather_spmd(x, root=0)
+        return True
+    with pytest.raises(CollectiveDivergenceError) as ei:
+        S.spmd(bad, pids=[0, 1])
+    assert "ndarray" in str(ei.value)
+
+
+def test_divergence_checker_unit():
+    ck = DivergenceChecker([0, 1])
+    ck.record(0, "barrier", "tag=None")
+    ck.record(1, "barrier", "tag=None")
+    ck.finish(0)
+    ck.finish(1)
+    ck.verify()
+    ck2 = DivergenceChecker([0, 1])
+    ck2.record(0, "barrier", "tag=None")
+    with pytest.raises(CollectiveDivergenceError):
+        ck2.record(1, "bcast", "root=0")
+    assert ck2.error is not None
+
+
+# ---------------------------------------------------------------------------
+# engine API shape
+# ---------------------------------------------------------------------------
+
+
+def test_finding_format_and_lint_paths(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text("from os import *\n")
+    fs = lint_paths([tmp_path])
+    assert len(fs) == 1 and isinstance(fs[0], Finding)
+    line = fs[0].format()
+    assert "DAL005" in line and str(f) in line

@@ -6,6 +6,16 @@
 LOG=/root/repo/tools/tpu_watch.log
 cd /root/repo
 echo "=== tpu_watch start $(date -u) ===" >> "$LOG"
+# Gate on dalint BEFORE any probing: a statically-broken tree (deadlock-
+# class collective bugs, hidden host syncs, hygiene violations) must
+# never burn a live-tunnel window.  The linter is AST-only — it cannot
+# wedge on the TPU runtime.
+if ! timeout 300 python -m distributedarrays_tpu.analysis lint \
+    distributedarrays_tpu examples bench.py >> "$LOG" 2>&1; then
+  echo "=== dalint FAILED — refusing to bench a broken tree ===" >> "$LOG"
+  exit 1
+fi
+echo "=== dalint clean $(date -u) ===" >> "$LOG"
 for i in $(seq 1 80); do
   echo "--- probe $i $(date -u) ---" >> "$LOG"
   if timeout 180 python -c "
