@@ -329,6 +329,9 @@ BANKED_SENTINELS = {
     "stencil": "stencil_8192_step_s_per_iter",
     "stencil_jnp": "stencil_8192_jnp_gcells_per_s",
     "stencil_temporal": "stencil_8192_temporal_s_per_iter",
+    "reshard_even": "reshard_even_s",
+    "reshard_uneven": "reshard_uneven_fill_s",
+    "reshard_mutate": "reshard_mutate_s",
     "broadcast_chain": "broadcast_chain_8192_s_per_iter",
     "mapreduce": "mapreduce_1e8_s_per_iter",
     "sort": "sort_1e7_s",
@@ -1449,6 +1452,123 @@ def main():
                 "decode_kvcache_steps": steps}
 
     _guarded(details, "decode_kvcache", cfg_decode, timeout_s=600)
+
+    # ---- extra: reshard planner (chunked collective redistribution) ------
+    # Three legs of the layout-aware reshard planner: the even→even
+    # transpose repartition (all_to_all lowering on >1 chip, noop/1-chip
+    # degenerate otherwise — strategy banked alongside the time so the
+    # numbers are attributable), the uneven-layout in-place fill (now
+    # emitted straight into blocked physical form: zero redistribution)
+    # next to a full re-pad rebind, and the incremental slice-mutate
+    # (owner-block writes only; the _comm_bytes_est column shows the
+    # sub-full-array traffic).
+    def cfg_reshard_even():
+        from distributedarrays_tpu import layout as L_
+        from distributedarrays_tpu.parallel import reshard as R_
+        p = len(devs)
+        NR = 8192
+        src = L_.sharding_for(list(range(p)), (p, 1), (NR, NR))
+        dst = L_.sharding_for(list(range(p)), (1, p), (NR, NR))
+        x = jax.device_put(jax.random.normal(jax.random.key(11), (NR, NR),
+                                             jnp.float32), src)
+        plan = R_.plan_reshard(x, dst)
+
+        def once():
+            y = R_.reshard(x, dst)
+            return float(y[0, 0])          # scalar fetch = sync
+
+        once()                             # compile
+        t_rs = min(_t(once) for _ in range(3))
+        out = {
+            "reshard_even_n": NR,
+            "reshard_even_nranks": p,
+            "reshard_even_strategy": plan.strategy,
+            "reshard_even_nchunks": plan.nchunks,
+            "reshard_even_plan_moved_mb": plan.moved_bytes / 2**20,
+            "reshard_even_s": t_rs,
+        }
+        if plan.moved_bytes:
+            out["reshard_even_gbps"] = plan.moved_bytes / t_rs / 1e9
+        # repeated same-pair planning must hit the plan cache
+        st0 = R_.plan_stats()
+        for _ in range(4):
+            R_.plan_reshard(x, dst)
+        out["reshard_plan_cache_hits_delta"] = \
+            R_.plan_stats()["hits"] - st0["hits"]
+        return out
+
+    _guarded(details, "reshard_even", cfg_reshard_even)
+
+    def cfg_reshard_uneven():
+        p = len(devs)
+        NU = 4096 * 2048 + 37              # indivisible -> blocked-padded
+        d = dat.distribute(np.zeros(NU, np.float32),
+                           procs=list(range(p)), dist=[p])
+        try:
+            def fill_once():
+                d.fill_(3.0)
+                return float(d.garray_padded[0])
+
+            from distributedarrays_tpu import telemetry as _tm2
+            fill_once()                    # compile
+            rb0 = _tm2.comm_bytes("reshard")
+            t_fill = min(_t(fill_once) for _ in range(3))
+            fill_reshard_bytes = _tm2.comm_bytes("reshard") - rb0
+
+            host = np.ones(NU, np.float32)
+
+            def repad_once():
+                dat.copyto_(d, host)       # logical -> blocked re-pad
+                return float(d.garray_padded[0])
+
+            repad_once()
+            t_repad = min(_t(repad_once) for _ in range(2))
+            return {
+                "reshard_uneven_n": NU,
+                "reshard_uneven_nranks": p,
+                "reshard_uneven_fill_s": t_fill,
+                "reshard_uneven_fill_reshard_bytes": fill_reshard_bytes,
+                "reshard_uneven_repad_s": t_repad,
+            }
+        finally:
+            d.close()
+
+    _guarded(details, "reshard_uneven", cfg_reshard_uneven)
+
+    def cfg_reshard_mutate():
+        p = len(devs)
+        NU = 4096 * 2048 + 37
+        d = dat.distribute(np.zeros(NU, np.float32),
+                           procs=list(range(p)), dist=[p])
+        try:
+            # one small interior window: the incremental path writes only
+            # the owner blocks' physical regions
+            lo = NU // (2 * max(p, 1))
+            w = 4096
+            v = np.full(w, 5.0, np.float32)
+
+            def mutate_once():
+                d[lo:lo + w] = v
+                return float(d.garray_padded[0])
+
+            from distributedarrays_tpu import telemetry as _tm2
+            mutate_once()                  # compile
+            rb0 = _tm2.comm_bytes("reshard")
+            t_mut = min(_t(mutate_once) for _ in range(3))
+            # reshard-kind bytes for the timed mutations alone: the
+            # owner-block traffic (vs NU*4 per mutation pre-planner)
+            rb = _tm2.comm_bytes("reshard") - rb0
+            return {
+                "reshard_mutate_n": NU,
+                "reshard_mutate_window": w,
+                "reshard_mutate_s": t_mut,
+                "reshard_mutate_touched_frac": w / NU,
+                "reshard_mutate_reshard_bytes_per_full": rb / 3 / (NU * 4),
+            }
+        finally:
+            d.close()
+
+    _guarded(details, "reshard_mutate", cfg_reshard_mutate)
 
     # ---- extra: distributed sort over 1e7 elements -----------------------
     def cfg_sort():

@@ -22,6 +22,10 @@ has one bad/good pair per rule):
 - DAL006  DArray constructed in a loop with no ``close()``/context
           discipline in the loop body — each iteration's HBM lingers until
           GC, the leak pattern the reference's finalizer tests guard.
+- DAL007  direct cross-sharding ``jax.device_put`` outside
+          ``parallel/reshard.py`` — whole-array eager moves bypass the
+          reshard planner (plan cache, chunked collective lowering,
+          moved-bytes accounting); route through ``parallel.reshard``.
 
 Rules are conservative by design: a rule that cannot prove its premise
 (axis bound elsewhere, value not traced, ...) stays silent.  Intentional
@@ -620,3 +624,73 @@ def _check_dal006(tree, path, lines):
                        f"the loop body never close()s one — per-iteration "
                        f"HBM lingers until GC (leak-prone; see "
                        f"core.d_closeall / DArray.close)")
+
+
+# ---------------------------------------------------------------------------
+# DAL007 — direct cross-sharding device_put outside the reshard planner
+# ---------------------------------------------------------------------------
+
+# the one module allowed to call device_put with a sharding target: the
+# planner itself (its device_put fallback IS the planned strategy)
+_RESHARD_HOME = ("parallel/reshard.py", "parallel\\reshard.py")
+
+# second-argument expressions that are clearly NOT layout targets: a bare
+# device / device list moves data without re-laying it out (host staging,
+# single-device pins) — the planner has nothing to plan there
+_DEVICE_ONLY_HINTS = {"device", "dev", "devices", "local_device",
+                      "backend"}
+
+
+def _sharding_like_arg(node: ast.expr) -> bool:
+    """Conservatively true when a device_put second argument looks like a
+    *sharding* (layout) rather than a bare device: a NamedSharding/
+    PositionalSharding construction, a ``*sharding*``-named variable or
+    attribute chain, or a ``.sharding`` access."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _last_seg(_call_name(n)) or ""
+            if "Sharding" in name or name in ("sharding_for",
+                                              "padded_sharding_for"):
+                return True
+        if isinstance(n, ast.Attribute) and "sharding" in n.attr.lower():
+            return True
+        if isinstance(n, ast.Name) and "sharding" in n.id.lower():
+            return True
+        if isinstance(n, ast.Name) and n.id.lower() in ("sh", "psh",
+                                                        "mesh_sh"):
+            return True
+    return False
+
+
+@_rule("DAL007", "warning",
+       "direct cross-sharding device_put outside parallel/reshard.py")
+def _check_dal007(tree, path, lines):
+    norm = path.replace("\\", "/")
+    if any(norm.endswith(h.replace("\\", "/")) for h in _RESHARD_HOME):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_seg(_call_name(node)) != "device_put":
+            continue
+        target = None
+        if len(node.args) >= 2:
+            target = node.args[1]
+        else:
+            for k in node.keywords:
+                if k.arg in ("device", "sharding"):
+                    target = k.value
+        if target is None:
+            continue
+        if isinstance(target, ast.Name) and \
+                target.id.lower() in _DEVICE_ONLY_HINTS:
+            continue
+        if not _sharding_like_arg(target):
+            continue
+        yield (node.lineno, node.col_offset,
+               "jax.device_put with a sharding target bypasses the "
+               "reshard planner (plan cache, chunked collective "
+               "lowering, moved-bytes accounting); use "
+               "parallel.reshard.reshard(x, sharding) — or suppress "
+               "with a justification if this site cannot have a "
+               "plannable source layout")

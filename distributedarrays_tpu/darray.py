@@ -22,6 +22,7 @@ reference's in-place ops (darray.jl:822-834) without fighting XLA.
 from __future__ import annotations
 
 import functools
+import itertools
 import numbers
 import threading
 import weakref
@@ -119,16 +120,12 @@ def _filler(kind: str, dims: tuple, dtype, sharding):
     return jax.jit(fn, out_shardings=sharding)
 
 
-@functools.lru_cache(maxsize=None)
 def _resharder(sharding):
-    # the body runs only on an lru miss — i.e. once per distinct target
-    # sharding — which is exactly the "new program" signal the journal's
-    # jit category tracks
-    _tm.count("jit.builds", fn="resharder")
-    # cold path: lru-miss body, once per distinct target sharding
-    _tm.event("jit", "build", fn="resharder",  # dalint: disable=DAL003
-              to=str(sharding))
-    return jax.jit(lambda x: x, out_shardings=sharding)
+    """Compiled identity placement program (kept as a thin alias: the one
+    cache now lives in ``parallel.reshard``, next to the transfer-plan
+    cache that keys on both endpoints)."""
+    from .parallel import reshard as _rs
+    return _rs._resharder(sharding)
 
 
 # ---------------------------------------------------------------------------
@@ -186,6 +183,43 @@ def _blocked_unpad_jit(cuts_key, lsharding):
     return jax.jit(fn, out_shardings=lsharding)
 
 
+@functools.lru_cache(maxsize=None)
+def _blocked_filler(kind: str, cuts_key, dtype, psharding):
+    """Fill/rand program emitting straight into blocked-padded physical
+    form (valid chunk regions filled, pad kept zero) — in-place fills on
+    uneven layouts do ZERO redistribution: no logical-array generate, no
+    re-pad, one compiled program with the padded sharding."""
+    cuts = [list(c) for c in cuts_key]
+    bs = L.block_sizes(cuts)
+    pdims = L.padded_dims(cuts)
+    sizes = [np.diff(np.asarray(c, dtype=np.int64)) for c in cuts]
+
+    def valid_mask():
+        m = None
+        for d, (b, sz) in enumerate(zip(bs, sizes)):
+            if pdims[d] == 0 or b == 0:
+                continue
+            idx = jnp.arange(pdims[d])
+            ok = (idx % b) < jnp.asarray(sz)[idx // b]
+            shape = [1] * len(pdims)
+            shape[d] = pdims[d]
+            m = ok.reshape(shape) if m is None else m & ok.reshape(shape)
+        return m
+
+    if kind == "fill":
+        def fn(v):
+            return jnp.where(valid_mask(), jnp.full(pdims, v, dtype),
+                             jnp.zeros((), dtype))
+    elif kind == "rand":
+        def fn(key):
+            return jnp.where(valid_mask(),
+                             jax.random.uniform(key, pdims, dtype=dtype),
+                             jnp.zeros((), dtype))
+    else:  # pragma: no cover
+        raise ValueError(kind)
+    return jax.jit(fn, out_shardings=psharding)
+
+
 def _host_blocked_pad(arr: np.ndarray, cuts, bs, pdims) -> np.ndarray:
     """numpy blocked pad — used at construction so each device receives only
     its block (never a full logical replica)."""
@@ -206,6 +240,52 @@ def _cuts_key(cuts) -> tuple:
 # ---------------------------------------------------------------------------
 # DArray
 # ---------------------------------------------------------------------------
+
+
+# one process-wide lock for share-group membership (_shared reads/writes
+# and count updates): group formation and departure must be atomic, or
+# two concurrent aligned samedist calls on one source could mint two
+# tokens for one buffer and under-count its holders
+_share_lock = threading.Lock()
+
+
+class _BufShare:
+    """Shared-ownership token for one jax buffer referenced by more than
+    one DArray (the aligned ``samedist`` fast path): ``close()`` deletes
+    the device buffer only when the LAST holder releases it, so skipping
+    the defensive copy cannot invalidate the other wrapper."""
+
+    __slots__ = ("buf", "count")
+
+    def __init__(self, buf, count: int = 1):
+        self.buf = buf
+        self.count = count
+
+    def release(self, buf) -> bool:
+        """True iff the caller should delete ``buf`` now.  A holder that
+        rebound to a different buffer owns that one exclusively.  When
+        the last holder leaves, the token drops its own reference too —
+        it must never outlive the group and pin the buffer."""
+        with _share_lock:
+            if buf is not self.buf:
+                return True
+            self.count -= 1
+            last = self.count <= 0
+            if last:
+                self.buf = None
+            return last
+
+
+def _share_buffer(src: "DArray", dst: "DArray") -> None:
+    """Record that ``src`` and ``dst`` now hold the same buffer."""
+    buf = src._data
+    with _share_lock:
+        tok = src._shared
+        if tok is None or tok.buf is not buf:
+            tok = _BufShare(buf, 1)
+            src._shared = tok
+        tok.count += 1
+        dst._shared = tok
 
 
 class DArray:
@@ -232,6 +312,7 @@ class DArray:
         "_psharding",
         "_closed",
         "_mutlock",
+        "_shared",
         "__weakref__",
     )
 
@@ -255,11 +336,8 @@ class DArray:
             psh = L.padded_sharding_for(flat_pids, grid, pdims)
             if tuple(data.shape) == pdims:
                 if getattr(data, "sharding", psh) != psh:
-                    with _tm.span("reshard", op="padded_relayout"):
-                        if _tm.enabled():
-                            _tm.record_comm("reshard", _tm.nbytes_of(data),
-                                            op="padded_relayout")
-                        data = jax.device_put(data, psh)
+                    from .parallel import reshard as _rs
+                    data = _rs.reshard(data, psh, op="padded_relayout")
             elif tuple(data.shape) == dims:
                 with _tm.span("reshard", op="blocked_pad"):
                     if _tm.enabled():
@@ -281,6 +359,7 @@ class DArray:
             self._sharding = data.sharding
         self._data = data
         self._closed = False
+        self._shared = None          # _BufShare when a buffer is co-owned
         # serializes read-modify-write mutations (set_localpart/setitem)
         # from concurrent SPMD rank tasks: the reference's workers own
         # disjoint chunks in separate processes, here they share one buffer
@@ -388,13 +467,28 @@ class DArray:
     def _close(self, _unregister=True):
         if not self._closed:
             self._closed = True
-            try:
-                self._data.delete()
-            except Exception:
-                pass
+            sh = self._shared
+            self._shared = None
+            if sh is None or sh.release(self._data):
+                try:
+                    self._data.delete()
+                except Exception:
+                    pass
             self._data = None
             if _unregister:
                 core.unregister(self.id)
+
+    def _leave_share(self):
+        """Detach from a shared-buffer group BEFORE ``_data`` is replaced
+        (rebind/mutation): the departing holder must not leave the token
+        counting it — otherwise the remaining holder's ``close()`` would
+        under-count and never eagerly delete, and the token's reference
+        would pin the old buffer past every close."""
+        tok = self._shared
+        if tok is None:
+            return
+        self._shared = None
+        tok.release(self._data)
 
     def close(self):
         """Release device buffers now (reference ``close(d)``, core.jl:105)."""
@@ -504,7 +598,8 @@ class DArray:
                 self._check_open()
                 g2 = self._data.at[psl].set(value)
                 if g2.sharding != self._psharding:
-                    g2 = jax.device_put(g2, self._psharding)
+                    g2 = jax.device_put(g2, self._psharding)  # dalint: disable=DAL007 — padded-buffer placement restore, not a cross-layout reshard
+                self._leave_share()
                 self._data = g2
             return
         sl = tuple(slice(r.start, r.stop) for r in idx)
@@ -549,6 +644,81 @@ class DArray:
         with self._mutlock:
             self._rebind(updater(self.garray))
 
+    def _mutate_region(self, key, value):
+        """Region update.  Even layouts: one ``.at[...].set`` on the
+        sharded buffer (as before).  Padded (uneven) layouts with basic
+        int/slice keys: INCREMENTAL — the update touches only the owner
+        blocks' physical regions of the blocked-padded buffer (the same
+        at-set ``set_localpart`` does for exact chunks), instead of the
+        depad → update → repad full-array round trip.  Advanced keys fall
+        back to the full-array path."""
+        self._check_open()
+        basic = all(
+            isinstance(k, int)
+            or (isinstance(k, slice) and k.step in (None, 1))
+            for k in key)
+        if not self._padded or not basic:
+            self._mutate(lambda g: g.at[tuple(key)].set(value))
+            return
+        lo, hi = [], []
+        for d, k in enumerate(key):
+            if isinstance(k, int):
+                lo.append(k)
+                hi.append(k + 1)
+            else:
+                lo.append(k.start)
+                hi.append(k.stop)
+        if any(h <= l for l, h in zip(lo, hi)):
+            return                                   # empty region: no-op
+        region_shape = tuple(h - l for l, h in zip(lo, hi))
+        v = jnp.asarray(value, dtype=self.dtype)
+        # numpy basic-index semantics: value broadcasts to the result
+        # shape (int-indexed dims removed); reinsert size-1 dims there
+        for d, k in enumerate(key):
+            if isinstance(k, int) and v.ndim < len(region_shape):
+                v = jnp.expand_dims(v, d)
+        v = jnp.broadcast_to(v, region_shape)
+        spans = [L.chunk_span(c, l, h)
+                 for c, l, h in zip(self.cuts, lo, hi)]
+        # One eager at-set per owner block.  The buffer is SHARDED, so
+        # each set copies only the touched devices' blocks — k block
+        # writes stay bounded by ~one padded-buffer copy per device
+        # total, vs the old depad→update→repad path which materialized
+        # the ragged-axis-REPLICATED logical array on every device.
+        touched = 0
+        with self._mutlock:
+            self._check_open()
+            with _tm.span("reshard", op="incremental_mutate"):
+                g2 = self._data
+                for ci in itertools.product(
+                        *[range(a, b + 1) for a, b in spans]):
+                    psl, vsl, n = [], [], 1
+                    for d, k in enumerate(ci):
+                        cs, ce = self.cuts[d][k], self.cuts[d][k + 1]
+                        il, ih = max(cs, lo[d]), min(ce, hi[d])
+                        if il >= ih:
+                            n = 0
+                            break
+                        b = self._bs[d]
+                        psl.append(slice(b * k + (il - cs),
+                                         b * k + (ih - cs)))
+                        vsl.append(slice(il - lo[d], ih - lo[d]))
+                        n *= ih - il
+                    if n == 0:
+                        continue
+                    g2 = g2.at[tuple(psl)].set(v[tuple(vsl)])
+                    touched += n * v.dtype.itemsize
+                if _tm.enabled():
+                    # owner-block bytes only — the sub-full-array traffic
+                    # the incremental path exists to deliver
+                    _tm.record_comm("reshard", touched,
+                                    op="incremental_mutate",
+                                    shape=list(region_shape))
+                if g2.sharding != self._psharding:
+                    g2 = jax.device_put(g2, self._psharding)  # dalint: disable=DAL007 — padded-buffer placement restore, not a cross-layout reshard
+                self._leave_share()
+                self._data = g2
+
     def _rebind(self, new_data: jax.Array):
         """Swap the backing buffer in place (mutation-API support).
         ``new_data`` is always the *logical* global array; uneven layouts
@@ -556,6 +726,7 @@ class DArray:
         self._check_open()
         if new_data.shape != tuple(self.dims):
             raise ValueError("rebind shape mismatch")
+        self._leave_share()
         if self._padded:
             with _tm.span("reshard", op="blocked_pad"):
                 if _tm.enabled():
@@ -565,16 +736,11 @@ class DArray:
                                               self._psharding)(new_data)
             return
         if new_data.sharding != self._sharding:
-            if new_data.size == 0:
-                # XLA rejects out_shardings on zero-element results;
-                # device_put places them fine
-                new_data = jax.device_put(new_data, self._sharding)
-            else:
-                with _tm.span("reshard", op="rebind"):
-                    if _tm.enabled():
-                        _tm.record_comm("reshard", _tm.nbytes_of(new_data),
-                                        op="rebind", shape=list(self.dims))
-                    new_data = _resharder(self._sharding)(new_data)
+            # planner-routed: repeated same-layout-pair rebinds hit the
+            # plan cache; divisible repartitions run the chunked
+            # collective program instead of a whole-array device_put
+            from .parallel import reshard as _rs
+            new_data = _rs.reshard(new_data, self._sharding, op="rebind")
         self._data = new_data
 
     def with_data(self, new_data: jax.Array, did=None) -> "DArray":
@@ -612,7 +778,7 @@ class DArray:
             value = value.garray
         elif isinstance(value, SubDArray):
             value = value.materialize()
-        self._mutate(lambda g: g.at[tuple(key)].set(value))
+        self._mutate_region(key, value)
 
     def makelocal(self, *I) -> jax.Array:
         """Materialize the region ``I`` as a dense local array
@@ -655,9 +821,24 @@ class DArray:
         (darray.jl:403-441).  NOT numpy semantics: ``a == b`` never returns
         an elementwise array here, while ``<``, ``<=``, ``>``, ``>=`` ARE
         elementwise.  For an elementwise comparison use
-        ``dmap(jnp.equal, a, b)``."""
+        ``dmap(jnp.equal, a, b)``.
+
+        DArray/SubDArray operands compare DEVICE-SIDE (one compiled
+        array_equal over the sharded buffers — no host gather); only
+        numpy inputs and cross-device-set operands take the host path."""
         if isinstance(other, (DArray, SubDArray)):
-            other = np.asarray(other)
+            oshape = tuple(other.dims) if isinstance(other, DArray) \
+                else tuple(other.shape)
+            if oshape != self.dims:
+                return False
+            try:
+                og = other.garray if isinstance(other, DArray) \
+                    else other.materialize()
+                return bool(jnp.array_equal(self.garray, og))
+            except Exception:
+                # committed to disjoint device sets (or similar): the
+                # compiled compare cannot bind both — host fallback
+                other = np.asarray(other)
         elif not isinstance(other, (np.ndarray, jax.Array)):
             return NotImplemented
         if tuple(np.shape(other)) != self.dims:
@@ -684,14 +865,33 @@ class DArray:
         return self.with_data(_fresh(g.astype(dtype), g))
 
     def fill_(self, x) -> "DArray":
-        """In-place fill (reference ``fill!``, darray.jl:822-827)."""
+        """In-place fill (reference ``fill!``, darray.jl:822-827).  Padded
+        layouts fill the blocked physical buffer directly (pad stays
+        zero) — zero redistribution."""
+        if self._padded:
+            with self._mutlock:
+                self._check_open()
+                self._leave_share()
+                self._data = _blocked_filler(
+                    "fill", _cuts_key(self.cuts), np.dtype(self.dtype),
+                    self._psharding)(jnp.asarray(x, dtype=self.dtype))
+            return self
         sh = self._sharding
         self._rebind(_filler("fill", self.dims, np.dtype(self.dtype), sh)(
             jnp.asarray(x, dtype=self.dtype)))
         return self
 
     def rand_(self) -> "DArray":
-        """In-place uniform refill (reference ``rand!``, darray.jl:829-834)."""
+        """In-place uniform refill (reference ``rand!``, darray.jl:829-834).
+        Padded layouts generate straight into blocked physical form."""
+        if self._padded:
+            with self._mutlock:
+                self._check_open()
+                self._leave_share()
+                self._data = _blocked_filler(
+                    "rand", _cuts_key(self.cuts), np.dtype(self.dtype),
+                    self._psharding)(_next_key())
+            return self
         self._rebind(_filler("rand", self.dims, np.dtype(self.dtype),
                              self._sharding)(_next_key()))
         return self
@@ -767,7 +967,16 @@ class SubDArray:
 
     def __eq__(self, other):
         if isinstance(other, (DArray, SubDArray)):
-            other = np.asarray(other)
+            oshape = tuple(other.dims) if isinstance(other, DArray) \
+                else tuple(other.shape)
+            if oshape != tuple(self.shape):
+                return False
+            try:
+                og = other.garray if isinstance(other, DArray) \
+                    else other.materialize()
+                return bool(jnp.array_equal(self.materialize(), og))
+            except Exception:
+                other = np.asarray(other)
         elif not isinstance(other, (np.ndarray, jax.Array)):
             return NotImplemented
         if tuple(np.shape(other)) != tuple(self.shape):
@@ -958,15 +1167,14 @@ def _put_global(host, sharding) -> jax.Array:
 
 
 def _put_global_impl(host, sharding) -> jax.Array:
+    from .parallel import reshard as _rs
     if isinstance(host, jax.Array) and _spans_processes(host.sharding):
         if host.sharding.device_set == sharding.device_set:
-            # same devices, new layout: ONE compiled identity program
-            # (_resharder is lru_cached on the sharding — no per-call
-            # retrace)
-            if _tm.enabled():
-                _tm.record_comm("reshard", _tm.nbytes_of(host),
-                                op="put_global", shape=list(host.shape))
-            return _resharder(sharding)(host)
+            # same devices, new layout: planner-routed — ONE compiled
+            # program (chunked collective when the layouts divide, the
+            # cached identity resharder otherwise); both are legal under
+            # multi-controller SPMD (every process enters this call)
+            return _rs.reshard(host, sharding, op="put_global")
         # device sets differ (e.g. a reduction shrank the rank grid below
         # the process count): replicate over the SOURCE mesh — compiled,
         # every owning process participates — then fall through to the
@@ -979,17 +1187,14 @@ def _put_global_impl(host, sharding) -> jax.Array:
             host.sharding.mesh, PartitionSpec()))(host)
         host = np.asarray(rep.addressable_data(0))
     if getattr(sharding, "is_fully_addressable", True):
-        # moving an existing device array to a new layout is a reshard
-        # (a no-op placement moves nothing); placing host data is a
-        # host→device scatter
+        # moving an existing device array to a new layout is a reshard —
+        # planner-routed; placing host data is a host→device scatter
+        if isinstance(host, jax.Array):
+            return _rs.reshard(host, sharding, op="put_global")
         if _tm.enabled():
-            if not isinstance(host, jax.Array):
-                _tm.record_comm("h2d", _tm.nbytes_of(host),
-                                op="device_put", shape=list(np.shape(host)))
-            elif host.sharding != sharding:
-                _tm.record_comm("reshard", _tm.nbytes_of(host),
-                                op="device_put", shape=list(host.shape))
-        return jax.device_put(host, sharding)
+            _tm.record_comm("h2d", _tm.nbytes_of(host),
+                            op="device_put", shape=list(np.shape(host)))
+        return jax.device_put(host, sharding)  # dalint: disable=DAL007 — host→device scatter, no source sharding to plan from
     arr = np.asarray(host)
     if _tm.enabled():
         _tm.record_comm("h2d", arr.nbytes, op="make_array_from_callback",
@@ -1473,7 +1678,8 @@ def copyto_(dest, src) -> "DArray":
             # same contract as the DArray path / reference DimensionMismatch
             raise ValueError(f"copyto_: src shape {tuple(val.shape)} != view "
                              f"shape {tuple(dest.shape)}")
-        parent._mutate(lambda g: g.at[tuple(key)].set(val))
+        # region-routed: uneven-layout views update only the owner blocks
+        parent._mutate_region(key, val)
         return dest
     if not isinstance(dest, DArray):
         raise TypeError("copyto_ expects a DArray or SubDArray destination")

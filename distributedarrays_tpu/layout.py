@@ -53,6 +53,9 @@ __all__ = [
     "prime_factors",
     "nranks",
     "all_ranks",
+    "cut_intersections",
+    "chunk_span",
+    "even_cuts",
 ]
 
 
@@ -180,6 +183,67 @@ def locate(cuts: Sequence[Sequence[int]], *I: int) -> tuple[int, ...]:
             j += 1
         out.append(j)
     return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Block algebra between two layouts (reshard planning support)
+# ---------------------------------------------------------------------------
+
+
+def cut_intersections(a_cuts: Sequence[int],
+                      b_cuts: Sequence[int]) -> list[tuple[int, int, int, int]]:
+    """Overlaps between the chunks of two cut vectors of one global extent.
+
+    Returns ``[(ai, bi, lo, hi), ...]``: the half-open interval ``[lo, hi)``
+    lies in chunk ``ai`` of ``a_cuts`` and chunk ``bi`` of ``b_cuts``.
+    Empty chunks produce no entries.  This is the 1-D kernel of the
+    reshard planner's chunk-intersection transfer plan: the N-D plan is
+    the cross product of the per-dimension overlap lists.
+    """
+    if a_cuts[-1] != b_cuts[-1]:
+        raise ValueError(
+            f"cut vectors cover different extents: {a_cuts[-1]} vs "
+            f"{b_cuts[-1]}")
+    out: list[tuple[int, int, int, int]] = []
+    ai = bi = 0
+    na, nb = len(a_cuts) - 1, len(b_cuts) - 1
+    while ai < na and bi < nb:
+        lo = max(a_cuts[ai], b_cuts[bi])
+        hi = min(a_cuts[ai + 1], b_cuts[bi + 1])
+        if lo < hi:
+            out.append((ai, bi, int(lo), int(hi)))
+        # advance whichever chunk ends first (ties advance both)
+        ae, be = a_cuts[ai + 1], b_cuts[bi + 1]
+        if ae <= be:
+            ai += 1
+        if be <= ae:
+            bi += 1
+    return out
+
+
+def chunk_span(cuts: Sequence[int], lo: int, hi: int) -> tuple[int, int]:
+    """Indices ``(first, last)`` (inclusive) of the non-empty chunks of
+    ``cuts`` intersecting the half-open interval ``[lo, hi)``.  Returns
+    ``(0, -1)`` for an empty interval.  The owner-block enumeration for
+    incremental region mutation."""
+    if hi <= lo:
+        return (0, -1)
+    first = locate([cuts], lo)[0]
+    last = locate([cuts], hi - 1)[0]
+    return (first, last)
+
+
+def even_cuts(dims: Sequence[int], grid: Sequence[int]) -> list[list[int]]:
+    """Cut vectors of an exactly-even chunk grid (the only grids XLA
+    shards physically).  Raises when a dim does not divide."""
+    cuts = []
+    for d, g in zip(dims, grid):
+        g = max(int(g), 1)
+        if d % g:
+            raise ValueError(f"extent {d} not divisible by {g} chunks")
+        step = d // g
+        cuts.append([step * i for i in range(g + 1)])
+    return cuts
 
 
 # ---------------------------------------------------------------------------

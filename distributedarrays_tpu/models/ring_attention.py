@@ -39,6 +39,8 @@ from jax.sharding import PartitionSpec as P
 
 from .. import layout as L
 from ..darray import DArray, _wrap_global
+from ..parallel.collectives import (axis_size as _axis_size,
+                                    shard_map_compat)
 
 __all__ = ["ring_attention", "ring_attention_kernel",
            "ring_flash_attention", "ring_flash_attention_kernel",
@@ -77,7 +79,7 @@ def ring_attention_kernel(q, k, v, axis: str, causal: bool = False,
     q, k, v: ``(block, heads, d)`` — the calling rank's sequence block.
     Runs inside ``shard_map`` with ``axis`` a 1-D mesh axis.
     """
-    nblk = lax.axis_size(axis)
+    nblk = _axis_size(axis)
     me = lax.axis_index(axis)
     b, h, dh = q.shape
     sc = jnp.asarray(1.0 / np.sqrt(dh) if scale is None else scale, q.dtype)
@@ -124,8 +126,8 @@ def _ring_jit(mesh, causal: bool):
     def fn(q, k, v):
         return ring_attention_kernel(q, k, v, axis, causal=causal)
 
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                                 out_specs=spec, check_vma=False))
+    return jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec, check=False))
 
 
 def ring_attention(q: DArray, k: DArray, v: DArray,
@@ -156,7 +158,7 @@ def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,
                                        flash_carry_finalize,
                                        flash_carry_init)
 
-    nblk = lax.axis_size(axis)
+    nblk = _axis_size(axis)
     me = lax.axis_index(axis)
     b, h, dh = q.shape
     sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
@@ -217,7 +219,7 @@ def _ring_flash_core_bwd(axis, causal, scale, block_q, block_k, interpret,
     from ..ops.pallas_attention import _LANE, flash_attention_hop_bwd
 
     q, k, v, oh, lse = res
-    nblk = lax.axis_size(axis)
+    nblk = _axis_size(axis)
     me = lax.axis_index(axis)
     b, h, dh = q.shape
     sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
@@ -341,8 +343,8 @@ def _ring_flash_jit(mesh, causal: bool, block_q: int, block_k: int,
                                            block_q=block_q, block_k=block_k,
                                            head_fold=head_fold)
 
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                                 out_specs=spec, check_vma=False))
+    return jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec, check=False))
 
 
 def ring_flash_attention(q: DArray, k: DArray, v: DArray,
@@ -436,7 +438,7 @@ def zigzag_ring_attention_kernel(q, k, v, axis: str,
     note above).  Causal only — for non-causal use the plain ring (the
     mask is the whole point of the layout).
     """
-    nblk = lax.axis_size(axis)
+    nblk = _axis_size(axis)
     me = lax.axis_index(axis)
     b, h, dh = q.shape
     if b % 2:
@@ -509,7 +511,7 @@ def _zigzag_flash_fwd_loop(q, k, v, axis, scale, block_q, block_k,
                                        flash_carry_finalize,
                                        flash_carry_init)
 
-    nblk = lax.axis_size(axis)
+    nblk = _axis_size(axis)
     me = lax.axis_index(axis)
     b, h, dh = q.shape
     if b % 2:
@@ -605,7 +607,7 @@ def _zigzag_flash_core_bwd(axis, scale, block_q, block_k, interpret, hfold,
     from ..ops.pallas_attention import _LANE, flash_attention_hop_bwd
 
     q, k, v, oh, lse = res
-    nblk = lax.axis_size(axis)
+    nblk = _axis_size(axis)
     me = lax.axis_index(axis)
     b, h, dh = q.shape
     half = b // 2
@@ -736,8 +738,8 @@ def _zigzag_flash_jit(mesh, block_q: int, block_k: int,
                                                   block_k=block_k,
                                                   head_fold=head_fold)
 
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                                 out_specs=spec, check_vma=False))
+    return jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec, check=False))
 
 
 def zigzag_ring_flash_attention(q: DArray, k: DArray, v: DArray,
@@ -785,8 +787,8 @@ def _zigzag_jit(mesh):
     def fn(q, k, v):
         return zigzag_ring_attention_kernel(q, k, v, axis)
 
-    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                                 out_specs=spec, check_vma=False))
+    return jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=(spec,) * 3,
+                                 out_specs=spec, check=False))
 
 
 def zigzag_ring_attention(q: DArray, k: DArray, v: DArray) -> DArray:

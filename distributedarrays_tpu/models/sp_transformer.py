@@ -40,6 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.collective_matmul import tp_ffn
 from ..parallel import collectives as C
+from ..parallel.collectives import axis_size as _axis_size
 from .ring_attention import (ring_flash_attention_kernel,
                              zigzag_ring_flash_attention_kernel)
 from .transformer import Config, _rmsnorm
@@ -106,7 +107,7 @@ def forward_local(params, tokens_loc, cfg: SPConfig, axis: str):
     H = cfg.heads
     E = cfg.dim
     D = E // H
-    p = lax.axis_size(axis)                  # static at trace time
+    p = _axis_size(axis)                  # static at trace time
     if S_loc * p > cfg.max_seq:
         # dynamic_slice would CLAMP out-of-table position reads (silently
         # reusing earlier ranks' embeddings); fail loudly instead, like
@@ -174,7 +175,7 @@ def _loss_partial(params, tokens_loc, cfg: SPConfig, axis: str):
     chunk (rank p-1's: its own second chunk), and chunk ``2p-1-i``'s
     successor ``2p-i`` is rank i-1's SECOND chunk (rank 0's: the global
     end, masked)."""
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     me = lax.axis_index(axis)
     Bt, S_loc = tokens_loc.shape
 
@@ -240,7 +241,7 @@ def _resolve_cfg(cfg: SPConfig, mesh, axis: str, tokens_shape) -> SPConfig:
 def make_grad_fn(mesh, cfg: SPConfig, axis: str = "p"):
     """The (loss, grads) program shared by both train steps: tokens
     sharded ``(b, s/p)``, replicated-param grads psum'd EXPLICITLY
-    (check_vma=False disables shard_map's automatic replication
+    (check=False disables shard_map's automatic replication
     accounting), FFN-shard grads staying sharded.  The returned callable
     resolves ``None`` hop knobs per call (``_resolve_cfg``) and
     dispatches to a shard_map program cached on the RESOLVED config, so
@@ -264,7 +265,7 @@ def _grad_program(mesh, cfg: SPConfig, axis: str):
         part, g = jax.value_and_grad(_loss_partial)(params, tokens_loc,
                                                     cfg, axis)
         loss = lax.psum(part, axis)
-        # check_vma=False puts replication maintenance on us: each rank's
+        # check=False puts replication maintenance on us: each rank's
         # grad for a REPLICATED param is only its partial (its own token
         # shard's contribution) — without this psum the per-rank param
         # copies silently diverge after the first update (caught by the
@@ -277,9 +278,9 @@ def _grad_program(mesh, cfg: SPConfig, axis: str):
             specs, g)
         return loss, g
 
-    return jax.shard_map(local, mesh=mesh,
+    return C.shard_map_compat(local, mesh=mesh,
                          in_specs=(specs, P(None, axis)),
-                         out_specs=(P(), specs), check_vma=False)
+                         out_specs=(P(), specs), check=False)
 
 
 def make_optax_train_step(mesh, cfg: SPConfig, tx, axis: str = "p"):

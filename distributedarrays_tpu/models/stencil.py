@@ -27,7 +27,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import layout as L
 from ..darray import DArray, _wrap_global, distribute
-from ..parallel.collectives import halo_exchange, halo_exchange_2d
+from ..parallel.collectives import (axis_size as _axis_size, halo_exchange,
+                                    halo_exchange_2d, shard_map_compat)
 
 __all__ = ["stencil5_step", "stencil5", "stencil3x3", "life_step", "life",
            "life2d"]
@@ -66,7 +67,7 @@ def _stencil_multistep(axis: str, k: int, weights):
     def steps(block):
         lo, hi = halo_exchange(block, axis, halo=k, dim=0, wrap=False)
         r = lax.axis_index(axis)
-        nr = lax.axis_size(axis)
+        nr = _axis_size(axis)
         return stencil3x3_multistep(block, lo, hi, k, r == 0, r == nr - 1,
                                     weights)
     return steps
@@ -101,9 +102,9 @@ def _stencil_jit(mesh, iters: int, use_pallas: bool, temporal: int,
         out, _ = lax.scan(body, block, None, length=iters)
         return out
 
-    return jax.jit(jax.shard_map(many, mesh=mesh,
+    return jax.jit(shard_map_compat(many, mesh=mesh,
                                  in_specs=P(axis, None),
-                                 out_specs=P(axis, None), check_vma=False))
+                                 out_specs=P(axis, None), check=False))
 
 
 def stencil5_step(d: DArray) -> DArray:
@@ -211,9 +212,9 @@ def _life_jit(mesh, iters: int):
         out, _ = lax.scan(body, block, None, length=iters)
         return out
 
-    return jax.jit(jax.shard_map(many, mesh=mesh,
+    return jax.jit(shard_map_compat(many, mesh=mesh,
                                  in_specs=P(axis, None),
-                                 out_specs=P(axis, None), check_vma=False))
+                                 out_specs=P(axis, None), check=False))
 
 
 @functools.lru_cache(maxsize=32)
@@ -236,9 +237,9 @@ def _life2d_jit(mesh, iters: int):
         out, _ = lax.scan(body, block, None, length=iters)
         return out
 
-    return jax.jit(jax.shard_map(many, mesh=mesh,
+    return jax.jit(shard_map_compat(many, mesh=mesh,
                                  in_specs=P(ax0, ax1),
-                                 out_specs=P(ax0, ax1), check_vma=False))
+                                 out_specs=P(ax0, ax1), check=False))
 
 
 def life2d(d: DArray, iters: int = 1) -> DArray:

@@ -91,7 +91,7 @@ def shard_params(params, mesh: Mesh):
     tp), proj/w2 row-parallel (split input features); embeddings and norms
     replicated."""
     def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, NamedSharding(mesh, spec))  # dalint: disable=DAL007 — initial host→mesh parameter placement, no source layout
 
     out = {
         "embed": put(params["embed"], P(None, None)),

@@ -110,7 +110,7 @@ def _replicate(r, mesh_sh, warn_key=None, warn_msg=None):
     if _tm.enabled():
         _tm.record_comm("replicate", _tm.nbytes_of(r),
                         op="broadcast_align", journal=warn_key is not None)
-    return jax.device_put(
+    return jax.device_put(  # dalint: disable=DAL007 — intentional replication of a layout-misfit arg (often host/uncommitted); the planner has no source layout to improve on
         r, jax.sharding.NamedSharding(mesh_sh.mesh,
                                       jax.sharding.PartitionSpec()))
 
@@ -158,9 +158,10 @@ def _align_devices(raw, sharding):
             else:
                 try:
                     from ..darray import _put_global
-                    # rank-compatible reshard; _put_global picks the eager
-                    # device_put (single-controller) or the
-                    # compiled/gathered multi-controller move
+                    # rank-compatible reshard, planner-routed: _put_global
+                    # hands device arrays to parallel.reshard (plan cache
+                    # + chunked collective lowering) and keeps the
+                    # host-scatter / multi-controller replicate branches
                     r = _put_global(r, mesh_sh)
                 except (ValueError, TypeError) as e:
                     # backstop for failures the pre-check cannot see

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..parallel.collectives import pshift
+from ..parallel.collectives import axis_size as _axis_size, pshift
 
 __all__ = ["allgather_matmul", "allgather_matmul_rhs",
            "matmul_reducescatter", "cannon_matmul", "cannon_matmul_int8",
@@ -66,7 +66,7 @@ def allgather_matmul(x, w, axis: str):
     next chunk from rank ``r + 1`` — compute covers the hop.  p - 1
     hops total (the last resident chunk multiplies outside the loop).
     """
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     out_dtype = jnp.result_type(x.dtype, w.dtype)
     if p == 1:
         return (x @ w).astype(out_dtype)
@@ -107,7 +107,7 @@ def allgather_matmul_rhs(a, b, axis: str):
     p`` is resident and contracts against ``a[:, src*k_loc:(src+1)*
     k_loc]``; p - 1 hops total.
     """
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     out_dtype = jnp.result_type(a.dtype, b.dtype)
     if p == 1:
         return (a @ b).astype(out_dtype)
@@ -146,7 +146,7 @@ def matmul_reducescatter(x, w, axis: str):
     contributions and sits on its destination rank; each hop's
     ``pshift`` overlaps the next block's matmul.
     """
-    p = lax.axis_size(axis)
+    p = _axis_size(axis)
     r = lax.axis_index(axis)
     m, _ = x.shape
     if m % p:
@@ -192,11 +192,11 @@ def cannon_matmul(a, b, row_axis: str, col_axis: str):
     rings hides behind the local GEMMs.  Square grids only: on ``(r, c)``
     with ``r != c`` the panels misalign mid-ring (GSPMD owns that shape).
     """
-    g = lax.axis_size(row_axis)
-    if lax.axis_size(col_axis) != g:
+    g = _axis_size(row_axis)
+    if _axis_size(col_axis) != g:
         raise ValueError(
             f"cannon_matmul needs a square grid; got "
-            f"{g}x{lax.axis_size(col_axis)}")
+            f"{g}x{_axis_size(col_axis)}")
     out_dtype = jnp.result_type(a.dtype, b.dtype)
     if g == 1:
         return (a @ b).astype(out_dtype)
@@ -252,8 +252,8 @@ def summa_matmul(a, b, row_axis: str, col_axis: str):
     (``linalg.tune_matmul_impl_summa``; GSPMD is the fallback).
     """
     import math as _math
-    r = lax.axis_size(row_axis)
-    c = lax.axis_size(col_axis)
+    r = _axis_size(row_axis)
+    c = _axis_size(col_axis)
     out_dtype = jnp.result_type(a.dtype, b.dtype)
     if r == 1 and c == 1:
         return (a @ b).astype(out_dtype)
@@ -297,11 +297,11 @@ def cannon_matmul_int8(a, b, row_axis: str, col_axis: str,
     """
     from .pallas_gemm import pallas_matmul_int8, quantize_rows, \
         quantized_matmul
-    g = lax.axis_size(row_axis)
-    if lax.axis_size(col_axis) != g:
+    g = _axis_size(row_axis)
+    if _axis_size(col_axis) != g:
         raise ValueError(
             f"cannon_matmul_int8 needs a square grid; got "
-            f"{g}x{lax.axis_size(col_axis)}")
+            f"{g}x{_axis_size(col_axis)}")
     if g == 1:
         return quantized_matmul(a, b, out_dtype=out_dtype,
                                 interpret=interpret)

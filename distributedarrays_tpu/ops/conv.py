@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..darray import DArray, _wrap_global, darray_from_cuts
-from ..parallel.collectives import halo_exchange
+from ..parallel.collectives import halo_exchange, shard_map_compat
 
 __all__ = ["dconv2d"]
 
@@ -85,7 +85,7 @@ def _conv_shm_jit(mesh, spec, hname, wname, hdim: int, wdim: int,
                                     axis=wdim)
         return full
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, PartitionSpec()),
         out_specs=spec))
 

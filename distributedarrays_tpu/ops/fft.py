@@ -47,7 +47,7 @@ def _sharded_dim(d: DArray):
 def _fft_shm_jit(mesh, spec, ax: int, shard_dim: int, name: str,
                  inverse: bool):
     op = jnp.fft.ifft if inverse else jnp.fft.fft
-    from ..parallel.collectives import pall_to_all
+    from ..parallel.collectives import pall_to_all, shard_map_compat
 
     def kernel(x):
         if ax != shard_dim:
@@ -59,7 +59,7 @@ def _fft_shm_jit(mesh, spec, ax: int, shard_dim: int, name: str,
         y = op(y, axis=ax)
         return pall_to_all(y, name, split_dim=ax, concat_dim=other)
 
-    return jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=spec,
+    return jax.jit(shard_map_compat(kernel, mesh=mesh, in_specs=spec,
                                  out_specs=spec))
 
 
@@ -82,7 +82,7 @@ def _fft1d_shm_jit(mesh, spec, name: str, n: int, p: int, inverse: bool):
     all_to_alls total; no host gather, no full-vector residency.
     """
     op = jnp.fft.ifft if inverse else jnp.fft.fft
-    from ..parallel.collectives import pall_to_all
+    from ..parallel.collectives import pall_to_all, shard_map_compat
     n2 = n // p
 
     def kernel(x):
@@ -107,7 +107,7 @@ def _fft1d_shm_jit(mesh, spec, name: str, n: int, p: int, inverse: bool):
         d_ = pall_to_all(c, name, split_dim=1, concat_dim=0)  # (p, n2/p)
         return d_.T.reshape(n2)
 
-    return jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=spec,
+    return jax.jit(shard_map_compat(kernel, mesh=mesh, in_specs=spec,
                                  out_specs=spec))
 
 

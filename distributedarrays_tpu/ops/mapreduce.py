@@ -17,7 +17,6 @@ XLA resharding.
 
 from __future__ import annotations
 
-import contextlib
 import functools
 import inspect
 from typing import Callable
@@ -32,6 +31,8 @@ from .. import telemetry as _tm
 from ..darray import (DArray, SubDArray, _wrap_global, darray, distribute,
                       from_chunks)
 from .broadcast import _jitted, _unwrap, _align_devices, elementwise
+from ..parallel.collectives import (axis_size as _axis_size,
+                                    shard_map_compat)
 
 __all__ = [
     "dreduce", "dmapreduce", "dsum", "dprod", "dmaximum", "dminimum",
@@ -393,7 +394,7 @@ def _scan_uneven_shm_jit(psharding, kind: str, ax: int, name):
         if name is None:        # scan dim whole per rank: local only
             return loc
         r = jax.lax.axis_index(name)
-        p = jax.lax.axis_size(name)
+        p = _axis_size(name)
         v = vcounts[r]
         neutral = _scan_neutral(kind, loc.dtype)
         tot = jax.lax.dynamic_index_in_dim(
@@ -405,7 +406,7 @@ def _scan_uneven_shm_jit(psharding, kind: str, ax: int, name):
         prefix = _SCAN_COMBINE[kind](filled, axis=0)
         return _SCAN_MERGE[kind](loc, prefix)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         kernel, mesh=psharding.mesh,
         in_specs=(psharding.spec, _P()), out_specs=psharding.spec))
 
@@ -421,13 +422,13 @@ def _scan_shm_jit(mesh, spec, kind: str, ax: int, name: str):
                                    keepdims=True)
         g = jax.lax.all_gather(tot, name)        # (p, ..., 1, ...)
         r = jax.lax.axis_index(name)
-        p = jax.lax.axis_size(name)
+        p = _axis_size(name)
         mask = (jnp.arange(p) < r).reshape((p,) + (1,) * loc.ndim)
         filled = jnp.where(mask, g, _scan_neutral(kind, g.dtype))
         prefix = _SCAN_COMBINE[kind](filled, axis=0)
         return _SCAN_MERGE[kind](loc, prefix)
 
-    return jax.jit(jax.shard_map(kernel, mesh=mesh, in_specs=spec,
+    return jax.jit(shard_map_compat(kernel, mesh=mesh, in_specs=spec,
                                  out_specs=spec))
 
 
@@ -472,7 +473,7 @@ def map_localparts(f: Callable, *ds, procs=None):
             mesh = d0.sharding.mesh
             specs = tuple(a.sharding.spec if isinstance(a, DArray) else None
                           for a in ds)
-            shmapped = jax.shard_map(
+            shmapped = shard_map_compat(
                 f, mesh=mesh, in_specs=specs, out_specs=d0.sharding.spec)
             raw = [a.garray if isinstance(a, DArray) else a for a in ds]
             res = jax.jit(shmapped)(*raw)
@@ -536,21 +537,29 @@ def _even_shared_layout(ds):
 
 def samedist(d: DArray, like: DArray) -> DArray:
     """Re-distribute ``d`` onto ``like``'s layout (reference samedist,
-    mapreduce.jl:172-178) — an XLA resharding (collective-permute over ICI)
-    instead of gather/re-scatter through the controller."""
+    mapreduce.jl:172-178) — planner-routed: divisible repartitions run as
+    one compiled chunked collective, and an ALIGNED samedist is free: the
+    result co-owns ``d``'s buffer (shared-ownership token, so ``close()``
+    on either side cannot invalidate the other) instead of paying a
+    full-array copy."""
     if d.dims != like.dims:
         raise ValueError(f"dims mismatch: {d.dims} vs {like.dims}")
-    from ..darray import _fresh
+    from ..darray import _fresh, _share_buffer
     g = d.garray
-    # span only when bytes actually move — an aligned samedist is a no-op
-    # placement and must not dilute the "reshard" span aggregates
-    cm = _tm.span("reshard", op="samedist") \
-        if g.sharding != like.sharding else contextlib.nullcontext()
-    with cm:
-        if _tm.enabled() and g.sharding != like.sharding:
-            _tm.record_comm("reshard", _tm.nbytes_of(g), op="samedist",
-                            shape=list(d.dims))
-        return like.with_data(_fresh(jax.device_put(g, like.sharding), g))
+    if g.sharding == like.sharding:
+        if not d._padded and not like._padded and g is d._data:
+            # aligned fast path: rebind the existing buffer (no
+            # device_put, no copy); buffer deletion deferred to the last
+            # co-owner via the share token
+            out = like.with_data(g)
+            _share_buffer(d, out)
+            return out
+        # padded source: g is the transient unpadded view — already a
+        # fresh buffer, safe to hand over without another copy
+        return like.with_data(g)
+    from ..parallel import reshard as _rs
+    return like.with_data(
+        _fresh(_rs.reshard(g, like.sharding, op="samedist"), g))
 
 
 # ---------------------------------------------------------------------------

@@ -59,6 +59,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import layout as L
 from ..darray import DArray, SubDArray, _wrap_global, distribute, from_chunks
+from ..parallel.collectives import shard_map_compat
 
 __all__ = ["dsort"]
 
@@ -216,9 +217,9 @@ def _psrs_mesh_jit(mesh, p, mp, dtype_str, by, rev, explicit_pivots=False):
         return merged, nvalid.reshape((1,)).astype(jnp.int32)
 
     extra_specs = (P(),) if explicit_pivots else ()
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         kernel, mesh=mesh, in_specs=(P(axis), P()) + extra_specs,
-        out_specs=(P(axis), P(axis)), check_vma=False))
+        out_specs=(P(axis), P(axis)), check=False))
 
 
 @functools.lru_cache(maxsize=32)

@@ -1,4 +1,4 @@
-from . import collectives, multihost, spmd_mode  # noqa: F401
+from . import collectives, multihost, reshard, spmd_mode  # noqa: F401
 from .collectives import (axis_rank, axis_size, halo_exchange, pall_to_all,
                           pbarrier, pbcast, pgather, preduce, pshift,
                           run_spmd, spmd_mesh)

@@ -39,9 +39,33 @@ from .. import layout as L
 from .. import telemetry as _tm
 
 __all__ = [
-    "spmd_mesh", "run_spmd", "pshift", "halo_exchange", "pbarrier",
-    "pbcast", "pgather", "preduce", "pall_to_all", "axis_rank", "axis_size",
+    "spmd_mesh", "run_spmd", "shard_map_compat", "pshift", "halo_exchange",
+    "pbarrier", "pbcast", "pgather", "preduce", "pall_to_all", "axis_rank",
+    "axis_size",
 ]
+
+
+def shard_map_compat(f: Callable, mesh: Mesh, in_specs, out_specs,
+                     check: bool | None = None):
+    """``shard_map`` across jax versions: the stable ``jax.shard_map``
+    (``check_vma=``) when present, else the 0.4.x experimental API
+    (``jax.experimental.shard_map.shard_map``, ``check_rep=``).  Every
+    shard_map construction in the package goes through here so a jax
+    upgrade/downgrade is a one-site change.  ``check=None`` keeps the
+    library's own default (the replication/VMA check stays ON for call
+    sites that never opted out of it)."""
+    kw = {}
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        if check is not None:
+            kw["check_vma"] = check
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as _esm
+    if check is not None:
+        kw["check_rep"] = check
+    return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kw)
 
 
 def _rec(kind: str, x, axis: str, **fields) -> None:
@@ -77,8 +101,8 @@ def run_spmd(f: Callable, mesh: Mesh, in_specs, out_specs,
     _tm.event("jit", "build", fn="run_spmd",  # dalint: disable=DAL003
               once_key=f"run_spmd:{getattr(f, '__name__', f)!s}:"
                        f"{tuple(mesh.shape.items())}")
-    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=check_vma))
+    return jax.jit(shard_map_compat(f, mesh, in_specs, out_specs,
+                                    check=check_vma))
 
 
 def axis_rank(axis: str):
@@ -87,7 +111,14 @@ def axis_rank(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    """Static size of a mesh axis from inside a traced program.  Version
+    compat: ``lax.axis_size`` when present (new jax), else the 0.4.x
+    ``jax.core.axis_frame`` (which returns the size directly)."""
+    sz = getattr(lax, "axis_size", None)
+    if sz is not None:
+        return sz(axis)
+    import jax.core as _jc
+    return _jc.axis_frame(axis)
 
 
 def pshift(x, axis: str, shift: int = 1, wrap: bool = True):
@@ -97,7 +128,7 @@ def pshift(x, axis: str, shift: int = 1, wrap: bool = True):
 
     With ``wrap=False`` ranks at the boundary receive zeros.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     if wrap:
         perm = [(i, (i + shift) % n) for i in range(n)]
     else:
@@ -164,11 +195,13 @@ def pbcast(x, axis: str, root: int = 0):
     return lax.psum(masked, axis)
 
 
-def pgather(x, axis: str, tiled: bool = False):
+def pgather(x, axis: str, tiled: bool = False, dim: int = 0):
     """Concatenate every rank's block, pid-ordered (reference gather,
-    spmd.jl:214-231) → ``lax.all_gather``."""
+    spmd.jl:214-231) → ``lax.all_gather``.  ``dim`` picks the local axis
+    the blocks stack along (the reshard planner gathers along the
+    previously-sharded dim, not always dim 0)."""
     _rec("all_gather", x, axis, op="pgather")
-    return lax.all_gather(x, axis, tiled=tiled)
+    return lax.all_gather(x, axis, axis=dim, tiled=tiled)
 
 
 _PREDUCERS = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,

@@ -24,7 +24,7 @@ params = T.shard_params(T.init_params(jax.random.key(0), cfg), mesh)
 # task: every sequence counts upward mod vocab
 start = jax.random.randint(jax.random.key(1), (16, 1), 0, cfg.vocab)
 tokens = ((start + jnp.arange(cfg.max_seq)[None]) % cfg.vocab).astype(jnp.int32)
-tokens = jax.device_put(tokens, jax.NamedSharding(mesh, P("dp", None)))
+tokens = jax.device_put(tokens, jax.NamedSharding(mesh, P("dp", None)))  # dalint: disable=DAL007 — host token batch scatter, no source layout
 
 for step in range(60):
     params, loss = T.train_step(params, tokens, jnp.float32(0.05), cfg)

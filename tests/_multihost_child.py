@@ -38,6 +38,7 @@ import jax.numpy as jnp  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from distributedarrays_tpu.parallel import multihost  # noqa: E402
+from distributedarrays_tpu.parallel.collectives import shard_map_compat  # noqa: E402
 
 multihost.initialize(coordinator_address=f"localhost:{port}",
                      num_processes=nprocs, process_id=proc_id)
@@ -54,12 +55,12 @@ mesh = multihost.global_mesh((N,), ("x",))
 sh = NamedSharding(mesh, P("x"))
 host = np.arange(float(N), dtype=np.float32)
 garr = jax.make_array_from_callback((N,), sh, lambda idx: host[idx])
-total = jax.jit(jax.shard_map(lambda x: jax.lax.psum(jnp.sum(x), "x"),
+total = jax.jit(shard_map_compat(lambda x: jax.lax.psum(jnp.sum(x), "x"),
                               mesh=mesh, in_specs=P("x"), out_specs=P()))(garr)
 assert float(total.addressable_data(0)) == N * (N - 1) / 2, total
 
 # --- one DArray constructed across processes ------------------------------
-import distributedarrays_tpu as dat  # noqa: E402
+import distributedarrays_tpu as dat
 
 A = np.arange(2.0 * N, dtype=np.float32)
 d = dat.distribute(A)  # default layout spans all global devices

@@ -1,6 +1,6 @@
 """dalint rule engine + SPMD collective-divergence checker tests.
 
-Static half: every rule (DAL001-DAL006) must fire on its bad example and
+Static half: every rule (DAL001-DAL007) must fire on its bad example and
 stay silent on the good one — the same bad/good pairs docs/analysis.md
 documents.  Runtime half: under DA_TPU_CHECK_DIVERGENCE=1 a rank-divergent
 SPMD program must abort with a per-rank collective-sequence diff (fast —
@@ -35,7 +35,7 @@ def codes(findings, *, suppressed=False):
 
 
 def test_rule_catalog_complete():
-    assert set(RULES) == {f"DAL00{i}" for i in range(1, 7)}
+    assert set(RULES) == {f"DAL00{i}" for i in range(1, 8)}
     for code, rule in RULES.items():
         assert rule.severity in ("error", "warning"), code
         assert rule.title, code
@@ -279,6 +279,52 @@ def test_dal006_close_discipline_passes():
            "    d = dat.dzeros((8, 8))\n"   # not in a loop
            "    return d\n")
     assert codes(lint_source(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# DAL007 — direct cross-sharding device_put outside the reshard planner
+# ---------------------------------------------------------------------------
+
+
+def test_dal007_flags_sharding_device_put():
+    src = ("import jax\n"
+           "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+           "def place(x, mesh):\n"
+           "    return jax.device_put(x, NamedSharding(mesh, P('d0')))\n")
+    assert codes(lint_source(src, "pkg/ops/thing.py")) == ["DAL007"]
+
+
+def test_dal007_flags_sharding_named_variable():
+    src = ("import jax\n"
+           "def place(x, out_sharding):\n"
+           "    return jax.device_put(x, out_sharding)\n")
+    assert codes(lint_source(src, "pkg/m.py")) == ["DAL007"]
+
+
+def test_dal007_silent_in_reshard_home():
+    src = ("import jax\n"
+           "def place(x, sharding):\n"
+           "    return jax.device_put(x, sharding)\n")
+    assert codes(lint_source(
+        src, "distributedarrays_tpu/parallel/reshard.py")) == []
+
+
+def test_dal007_silent_on_bare_device_targets():
+    src = ("import jax\n"
+           "def pin(x):\n"
+           "    device = jax.devices()[0]\n"
+           "    y = jax.device_put(x, device)\n"
+           "    return jax.device_put(y)\n")       # no target at all
+    assert codes(lint_source(src, "pkg/m.py")) == []
+
+
+def test_dal007_suppressible_with_justification():
+    src = ("import jax\n"
+           "def place(x, sharding):\n"
+           "    return jax.device_put(x, sharding)  "
+           "# dalint: disable=DAL007 — host scatter, no source layout\n")
+    fs = lint_source(src, "pkg/m.py")
+    assert codes(fs) == [] and codes(fs, suppressed=True) == ["DAL007"]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,475 @@
+"""Layout-aware reshard planner: plan correctness, plan caching, chunked
+collective lowering, and the incremental-mutation fast paths.
+
+The planner's contract: whatever strategy it picks, the result must be
+byte-identical to the ``jax.device_put`` oracle; the chunked collective
+path must account only its *moved* bytes (no full-array blowup); and
+repeated reshards of one layout pair must hit the plan cache.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import layout as L
+from distributedarrays_tpu.parallel import reshard as R
+from distributedarrays_tpu.telemetry.fixtures import telemetry_capture  # noqa: F401 (fixture)
+
+
+# ---------------------------------------------------------------------------
+# block algebra (layout.cut_intersections / chunk_span)
+# ---------------------------------------------------------------------------
+
+
+def test_cut_intersections_covers_extent():
+    a = [0, 13, 26, 38, 50]
+    b = [0, 25, 50]
+    overlaps = L.cut_intersections(a, b)
+    # the overlaps tile [0, 50) exactly, in order
+    assert overlaps[0][2] == 0 and overlaps[-1][3] == 50
+    for (prev, nxt) in zip(overlaps, overlaps[1:]):
+        assert prev[3] == nxt[2]
+    # every overlap lies inside both claimed chunks
+    for ai, bi, lo, hi in overlaps:
+        assert a[ai] <= lo < hi <= a[ai + 1]
+        assert b[bi] <= lo < hi <= b[bi + 1]
+
+
+def test_cut_intersections_identity_and_mismatch():
+    c = [0, 10, 20]
+    assert L.cut_intersections(c, c) == [(0, 0, 0, 10), (1, 1, 10, 20)]
+    with pytest.raises(ValueError):
+        L.cut_intersections([0, 10], [0, 20])
+
+
+def test_cut_intersections_empty_chunks():
+    # empty chunks (equal cut entries) produce no overlap entries
+    a = [0, 1, 2, 3, 3, 3, 3, 3, 3]          # trailing empties (sz < nc)
+    b = [0, 3]
+    overlaps = L.cut_intersections(a, b)
+    assert [(o[0], o[2], o[3]) for o in overlaps] == \
+        [(0, 0, 1), (1, 1, 2), (2, 2, 3)]
+
+
+def test_chunk_span():
+    cuts = [0, 13, 26, 38, 50]
+    assert L.chunk_span(cuts, 12, 27) == (0, 2)
+    assert L.chunk_span(cuts, 13, 26) == (1, 1)
+    assert L.chunk_span(cuts, 0, 50) == (0, 3)
+    assert L.chunk_span(cuts, 7, 7) == (0, -1)   # empty interval
+
+
+# ---------------------------------------------------------------------------
+# planner output ≡ device_put oracle (property sweep over layout pairs)
+# ---------------------------------------------------------------------------
+
+
+def _shardings_for(shape, grid):
+    n = int(np.prod(grid))
+    return L.sharding_for(list(range(n)), grid, shape)
+
+
+_GRIDS_2D = [(8, 1), (1, 8), (4, 1), (1, 4), (2, 1), (1, 2), (1, 1),
+             (4, 2), (2, 4)]
+
+
+def test_planner_matches_device_put_oracle_2d(rng):
+    # every src/dst grid pair on a divisible 2-D shape: planner result ==
+    # the plain device_put oracle, whatever strategy was planned
+    shape = (16, 24)
+    A = rng.standard_normal(shape).astype(np.float32)
+    seen = set()
+    for gs, gd in itertools.product(_GRIDS_2D, _GRIDS_2D):
+        src, dst = _shardings_for(shape, gs), _shardings_for(shape, gd)
+        x = jax.device_put(A, src)
+        plan = R.plan_reshard(x, dst)
+        seen.add(plan.strategy)
+        y = R.reshard(x, dst)
+        assert y.sharding == dst or plan.strategy == "noop", (gs, gd)
+        oracle = jax.device_put(A, dst)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(oracle)), \
+            (gs, gd, plan.strategy)
+    # the sweep must have exercised the planned collective lowerings,
+    # not just fallbacks
+    assert "all_to_all" in seen
+    assert {"noop", "device_put"} <= seen
+
+
+def test_planner_matches_oracle_random_uneven_cuts(rng):
+    # random (often uneven / ragged) 1-D layout pairs via distribute +
+    # samedist: uneven pairs take the fallback, even pairs the
+    # collective — both must equal the host oracle
+    for n, ps, pd in [(50, 4, 2), (64, 8, 4), (37, 4, 8), (48, 8, 8),
+                      (29, 2, 4), (96, 8, 2)]:
+        A = rng.standard_normal(n).astype(np.float32)
+        d = dat.distribute(A, procs=list(range(ps)), dist=[ps])
+        like = dat.dzeros((n,), procs=list(range(pd)), dist=[pd])
+        r = dat.samedist(d, like)
+        np.testing.assert_array_equal(np.asarray(r), A)
+        assert [int(c) for c in r.cuts[0]] == [int(c) for c in like.cuts[0]]
+        dat.d_closeall()
+
+
+def test_planner_replicated_and_gather_strategies(rng):
+    shape = (32, 16)
+    A = rng.standard_normal(shape).astype(np.float32)
+    sharded = _shardings_for(shape, (8, 1))
+    rep = NamedSharding(sharded.mesh, P())
+    x = jax.device_put(A, sharded)
+    plan = R.plan_reshard(x, rep)
+    assert plan.strategy == "all_gather"
+    z = R.reshard(x, rep)
+    np.testing.assert_array_equal(np.asarray(z), A)
+    # replicated -> sharded is comm-free local slicing
+    plan2 = R.plan_reshard(z, sharded)
+    assert plan2.strategy == "local_slice" and plan2.moved_bytes == 0
+    w = R.reshard(z, sharded)
+    assert w.sharding == sharded
+    np.testing.assert_array_equal(np.asarray(w), A)
+
+
+def test_chunked_lowering_matches_oracle(rng, monkeypatch):
+    # force tiny staging chunks so the pre-slice all_to_all chunking and
+    # the chunked all_gather actually run, then check exactness
+    monkeypatch.setenv("DA_TPU_RESHARD_CHUNK_MB", "0.0005")
+    shape = (64, 48)
+    A = rng.standard_normal(shape).astype(np.float32)
+    src, dst = _shardings_for(shape, (8, 1)), _shardings_for(shape, (1, 8))
+    x = jax.device_put(A, src)
+    plan = R.plan_reshard(x, dst)
+    assert plan.strategy == "all_to_all" and plan.nchunks > 1
+    y = R.reshard(x, dst, plan=plan)
+    np.testing.assert_array_equal(np.asarray(y), A)
+    rep = NamedSharding(src.mesh, P())
+    plang = R.plan_reshard(x, rep)
+    assert plang.strategy == "all_gather" and plang.nchunks > 1
+    z = R.reshard(x, rep, plan=plang)
+    np.testing.assert_array_equal(np.asarray(z), A)
+
+
+# ---------------------------------------------------------------------------
+# plan cache + telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_plan_cache_hits_via_telemetry(telemetry_capture, rng):
+    tm = telemetry_capture
+    shape = (16, 8)
+    A = rng.standard_normal(shape).astype(np.float32)
+    src, dst = _shardings_for(shape, (8, 1)), _shardings_for(shape, (1, 8))
+    x = jax.device_put(A, src)
+    R.plan_reshard(x, dst)                    # may build or already cached
+    req0 = tm.counter_value("reshard.plan_requests")
+    build0 = tm.counter_value("reshard.plan_builds")
+    for _ in range(5):
+        R.plan_reshard(x, dst)
+    assert tm.counter_value("reshard.plan_requests") - req0 == 5
+    # repeated same-layout-pair planning hits the lru — zero new builds
+    assert tm.counter_value("reshard.plan_builds") - build0 == 0
+
+
+def test_reshard_comm_bytes_bounded_by_plan(telemetry_capture, rng):
+    # peak-memory guard: the chunked path accounts exactly the plan's
+    # moved bytes — never the full logical array
+    tm = telemetry_capture
+    shape = (64, 64)
+    A = rng.standard_normal(shape).astype(np.float32)
+    src, dst = _shardings_for(shape, (8, 1)), _shardings_for(shape, (1, 8))
+    x = jax.device_put(A, src)
+    plan = R.plan_reshard(x, dst)
+    assert plan.strategy == "all_to_all"
+    b0 = tm.comm_bytes("reshard")
+    y = R.reshard(x, dst, plan=plan)
+    y.block_until_ready()
+    delta = tm.comm_bytes("reshard") - b0
+    assert delta == plan.moved_bytes
+    assert delta < plan.total_bytes           # no full-array blowup
+    assert plan.moved_bytes == plan.total_bytes * 7 // 8
+    # the strategy is attributed on the span and the plan event
+    spans = tm.spans("reshard")
+    assert any(s.get("labels", {}).get("strategy") == "all_to_all"
+               for s in spans)
+
+
+def test_plan_event_journaled(telemetry_capture, rng):
+    tm = telemetry_capture
+    shape = (8, 32)
+    A = rng.standard_normal(shape).astype(np.float32)
+    x = jax.device_put(A, _shardings_for(shape, (1, 8)))
+    R.plan_reshard(x, _shardings_for(shape, (8, 1)))
+    evs = tm.events("reshard")
+    assert any(e.get("name") == "plan" and "strategy" in e for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# rewired call sites
+# ---------------------------------------------------------------------------
+
+
+def test_rebind_routes_through_planner(telemetry_capture, rng):
+    tm = telemetry_capture
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    src = dat.distribute(A, dist=(8, 1))
+    dest = dat.dzeros((16, 8), dist=(1, 8))
+    b0 = tm.comm_bytes("reshard")
+    dat.copyto_(dest, src)                     # dest._rebind(src.garray)
+    np.testing.assert_array_equal(np.asarray(dest), A)
+    # moved-bytes accounting: (p-1)/p of the array, not all of it
+    assert tm.comm_bytes("reshard") - b0 == 16 * 8 * 4 * 7 // 8
+    dat.d_closeall()
+
+
+def test_samedist_aligned_fast_path_no_copy(telemetry_capture, rng):
+    tm = telemetry_capture
+    a = dat.distribute(rng.standard_normal((16, 8)).astype(np.float32))
+    b = dat.dzeros((16, 8), dtype=np.float32)
+    b0 = tm.comm_bytes("reshard")
+    c = dat.samedist(a, b)
+    # no reshard bytes AND no buffer copy — c co-owns a's buffer
+    assert tm.comm_bytes("reshard") - b0 == 0
+    assert c.garray is a.garray
+    # shared-ownership: closing either side must not invalidate the other
+    c.close()
+    assert not a.garray.is_deleted()
+    np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(a))          # still readable
+    a.close()
+
+
+def test_samedist_share_released_on_rebind(rng):
+    # a holder that REBINDS (fill_/mutation) leaves the share group, so
+    # the remaining holder's close() must eagerly delete the old buffer
+    # (regression: the token used to keep counting the departed holder
+    # and pinned the buffer past every close)
+    a = dat.distribute(np.ones((16, 8), np.float32))
+    b = dat.dzeros((16, 8), dtype=np.float32)
+    c = dat.samedist(a, b)
+    shared_buf = c.garray
+    a.fill_(0.0)                               # a rebinds, leaves group
+    c.close()                                  # sole holder: eager delete
+    assert shared_buf.is_deleted()
+    np.testing.assert_allclose(np.asarray(a), 0.0)   # a unaffected
+    a.close()
+
+
+def test_samedist_shared_buffer_close_order_reversed(rng):
+    a = dat.distribute(rng.standard_normal((8, 8)).astype(np.float32))
+    ref = np.asarray(a).copy()
+    b = dat.dzeros((8, 8), dtype=np.float32)
+    c = dat.samedist(a, b)
+    a.close()                                  # original goes first
+    np.testing.assert_array_equal(np.asarray(c), ref)
+    dat.d_closeall()
+
+
+def test_broadcast_align_routes_through_planner(rng):
+    # mismatched committed layouts in one elementwise op: the aligned arg
+    # goes through _put_global -> parallel.reshard; result is correct
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    B = rng.standard_normal((16, 8)).astype(np.float32)
+    da = dat.distribute(A, dist=(8, 1))
+    db = dat.distribute(B, dist=(1, 8))
+    r = da + db
+    np.testing.assert_allclose(np.asarray(r), A + B, rtol=1e-6)
+    dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# incremental mutation of padded (uneven) layouts
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_slice_mutate_touches_owner_blocks_only(
+        telemetry_capture, rng):
+    tm = telemetry_capture
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A.copy(), procs=[0, 1, 2, 3], dist=[4])
+    b0 = tm.comm_bytes("reshard")
+    d[10:30] = 99.0
+    want = A.copy()
+    want[10:30] = 99.0
+    np.testing.assert_array_equal(np.asarray(d), want)
+    delta = tm.comm_bytes("reshard") - b0
+    # only the touched window is accounted — sub-full-array traffic
+    assert 0 < delta <= 20 * 4
+    assert delta < 50 * 4
+    # the update never depadded: no blocked_pad reshard events recorded
+    evs = [e for e in tm.events("comm")
+           if e.get("name") == "reshard" and e.get("op") == "blocked_pad"]
+    assert not evs
+    d.close()
+
+
+def test_incremental_mutate_2d_multiblock(rng):
+    B = rng.standard_normal((50, 30)).astype(np.float32)
+    e = dat.distribute(B.copy(), dist=[4, 2])
+    want = B.copy()
+    e[7, 3:25] = 5.0
+    want[7, 3:25] = 5.0
+    e[4:40, 2] = np.arange(36, dtype=np.float32)
+    want[4:40, 2] = np.arange(36)
+    e[12:14, 14:16] = np.array([[1., 2.], [3., 4.]], np.float32)
+    want[12:14, 14:16] = [[1, 2], [3, 4]]
+    np.testing.assert_array_equal(np.asarray(e), want)
+    # pad regions stay zero after incremental writes
+    padded = np.asarray(jax.device_get(e.garray_padded))
+    cuts_r, cuts_c = e.cuts
+    bs = L.block_sizes(e.cuts)
+    for bi in range(len(cuts_r) - 1):
+        valid = cuts_r[bi + 1] - cuts_r[bi]
+        np.testing.assert_allclose(
+            padded[bi * bs[0] + valid:(bi + 1) * bs[0], :], 0.0)
+    e.close()
+
+
+def test_incremental_mutate_scalar_setitem_padded(rng):
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A.copy(), dist=[4])
+    with dat.allowscalar(True):
+        d[13] = 7.0
+    want = A.copy()
+    want[13] = 7.0
+    np.testing.assert_array_equal(np.asarray(d), want)
+    d.close()
+
+
+def test_subdarray_copyto_incremental(rng):
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A.copy(), dist=[4])
+    dat.copyto_(d[20:40], np.ones(20, np.float32))
+    want = A.copy()
+    want[20:40] = 1.0
+    np.testing.assert_array_equal(np.asarray(d), want)
+    d.close()
+
+
+def test_advanced_indexing_still_full_path(rng):
+    # array keys are not basic: must fall back to the full-array path and
+    # stay correct
+    A = rng.standard_normal(50).astype(np.float32)
+    d = dat.distribute(A.copy(), dist=[4])
+    idx = np.array([3, 17, 44])
+    d[idx] = 0.5
+    want = A.copy()
+    want[idx] = 0.5
+    np.testing.assert_array_equal(np.asarray(d), want)
+    d.close()
+
+
+def test_padded_fill_zero_redistribution(telemetry_capture, rng):
+    tm = telemetry_capture
+    d = dat.distribute(rng.standard_normal(50).astype(np.float32), dist=[4])
+    b0 = tm.comm_bytes("reshard")
+    d.fill_(5.0)
+    assert tm.comm_bytes("reshard") - b0 == 0    # no depad/repad round trip
+    np.testing.assert_allclose(np.asarray(d), 5.0)
+    padded = np.asarray(jax.device_get(d.garray_padded))
+    np.testing.assert_allclose(padded[51:52], 0.0)   # pad stays zero
+    b1 = tm.comm_bytes("reshard")
+    d.rand_()
+    assert tm.comm_bytes("reshard") - b1 == 0
+    v = np.asarray(d)
+    assert v.shape == (50,) and len(np.unique(v)) > 10
+    padded = np.asarray(jax.device_get(d.garray_padded))
+    np.testing.assert_allclose(padded[51:52], 0.0)
+    d.close()
+
+
+def test_padded_fill_2d_matches_logical(rng):
+    d = dat.distribute(rng.standard_normal((50, 30)).astype(np.float32),
+                       dist=[4, 2])
+    d.fill_(2.5)
+    np.testing.assert_allclose(np.asarray(d), 2.5)
+    assert float(dat.dsum(d)) == pytest.approx(50 * 30 * 2.5, rel=1e-5)
+    d.close()
+
+
+# ---------------------------------------------------------------------------
+# device-side __eq__
+# ---------------------------------------------------------------------------
+
+
+def test_eq_darray_device_side_no_gather(telemetry_capture, rng):
+    tm = telemetry_capture
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    a = dat.distribute(A)
+    b = dat.distribute(A.copy())
+    c = dat.distribute(A + 1.0)
+    d2h0 = tm.comm_bytes("d2h")
+    assert a == b
+    assert not (a == c)
+    assert a != c
+    # the compare ran on device: no gather-sized d2h traffic
+    assert tm.comm_bytes("d2h") - d2h0 == 0
+    # numpy operand still works (host path)
+    assert a == A
+    sub = a[0:16, 0:8]
+    assert sub == b
+    dat.d_closeall()
+
+
+def test_eq_shape_mismatch_and_foreign_types(rng):
+    a = dat.distribute(rng.standard_normal((4, 4)).astype(np.float32))
+    b = dat.distribute(rng.standard_normal((2, 8)).astype(np.float32))
+    assert not (a == b)
+    assert a != b
+    # foreign type: __eq__ returns NotImplemented, Python resolves to False
+    assert (a == "nope") is False
+    dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# DAL007
+# ---------------------------------------------------------------------------
+
+
+def test_dal007_flags_cross_sharding_device_put():
+    from distributedarrays_tpu.analysis import lint_source
+    bad = (
+        "import jax\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "def f(x, mesh):\n"
+        "    return jax.device_put(x, NamedSharding(mesh, P('d0')))\n"
+    )
+    findings = [f for f in lint_source(bad, "pkg/ops/thing.py")
+                if f.code == "DAL007"]
+    assert len(findings) == 1
+
+
+def test_dal007_silent_in_reshard_home_and_on_devices():
+    from distributedarrays_tpu.analysis import lint_source
+    src = (
+        "import jax\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "def f(x, mesh):\n"
+        "    return jax.device_put(x, NamedSharding(mesh, P('d0')))\n"
+    )
+    assert not [f for f in lint_source(
+        src, "distributedarrays_tpu/parallel/reshard.py")
+        if f.code == "DAL007"]
+    dev = (
+        "import jax\n"
+        "def f(x):\n"
+        "    device = jax.devices()[0]\n"
+        "    return jax.device_put(x, device)\n"
+    )
+    assert not [f for f in lint_source(dev, "pkg/m.py")
+                if f.code == "DAL007"]
+
+
+def test_dal007_suppressible():
+    from distributedarrays_tpu.analysis import lint_source
+    src = (
+        "import jax\n"
+        "def f(x, sharding):\n"
+        "    return jax.device_put(x, sharding)  "
+        "# dalint: disable=DAL007 — justified\n"
+    )
+    fs = [f for f in lint_source(src, "pkg/m.py") if f.code == "DAL007"]
+    assert len(fs) == 1 and fs[0].suppressed

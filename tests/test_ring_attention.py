@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 
 import distributedarrays_tpu as dat
+from distributedarrays_tpu.parallel.collectives import shard_map_compat
 from distributedarrays_tpu.models import ring_attention as RA
 
 
@@ -210,10 +211,10 @@ def test_zigzag_ring_differentiable(rng):
     zq = jnp.asarray(zigzag_shard(q, n))
     mesh = L.mesh_for(list(range(n)), (n, 1, 1))
     ax = mesh.axis_names[0]
-    shm = jax.shard_map(
+    shm = shard_map_compat(
         lambda a, b, c: zigzag_ring_attention_kernel(a, b, c, ax),
         mesh=mesh, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
-        check_vma=False)
+        check=False)
 
     def loss(x):
         return jnp.sum(shm(x, x, x).astype(jnp.float32) ** 2)
@@ -284,11 +285,11 @@ def test_ring_flash_differentiable(rng, causal):
     v = jnp.asarray(rng.standard_normal((S, H, D)).astype(np.float32))
     mesh = L.mesh_for(range(n), (n, 1, 1))
     ax = mesh.axis_names[0]
-    shm = jax.shard_map(
+    shm = shard_map_compat(
         lambda a, b, c: RA.ring_flash_attention_kernel(a, b, c, ax,
                                                        causal=causal),
         mesh=mesh, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
-        check_vma=False)
+        check=False)
     g = jax.jit(jax.grad(lambda a, b, c: jnp.sum(shm(a, b, c) ** 2),
                          (0, 1, 2)))(q, k, v)
     scale = float(1.0 / np.sqrt(D))
@@ -313,10 +314,10 @@ def test_zigzag_ring_flash_differentiable(rng):
     v = rng.standard_normal((S, H, D)).astype(np.float32)
     mesh = L.mesh_for(list(range(n)), (n, 1, 1))
     ax = mesh.axis_names[0]
-    shm = jax.shard_map(
+    shm = shard_map_compat(
         lambda a, b, c: zigzag_ring_flash_attention_kernel(a, b, c, ax),
         mesh=mesh, in_specs=(RP(ax),) * 3, out_specs=RP(ax),
-        check_vma=False)
+        check=False)
 
     # loss over the fused zigzag path, differentiating through the
     # zigzag reorder so gradients land in NATURAL order for the oracle
@@ -359,11 +360,11 @@ def test_ring_flash_blocks_from_registry(rng):
     q = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
 
     def run():
-        shm = jax.shard_map(
+        shm = shard_map_compat(
             lambda a, b, c: ring_flash_attention_kernel(a, b, c, ax,
                                                         causal=True),
             mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax),
-            check_vma=False)
+            check=False)
         return np.asarray(shm(q, q, q))
 
     want = reference_attention(np.asarray(q), np.asarray(q), np.asarray(q),
@@ -394,11 +395,11 @@ def test_ring_flash_head_fold_matches(rng):
     q = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
 
     def run():
-        shm = jax.shard_map(
+        shm = shard_map_compat(
             lambda a, b, c: ring_flash_attention_kernel(a, b, c, ax,
                                                         causal=True),
             mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax),
-            check_vma=False)
+            check=False)
         return shm(q, q, q)
 
     autotune.clear()
@@ -414,11 +415,11 @@ def test_ring_flash_head_fold_matches(rng):
         return jax.grad(lambda a: jnp.sum(run_with(a) ** 2))(q)
 
     def run_with(a):
-        shm = jax.shard_map(
+        shm = shard_map_compat(
             lambda x, b, c: ring_flash_attention_kernel(x, b, c, ax,
                                                         causal=True),
             mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax),
-            check_vma=False)
+            check=False)
         return shm(a, q, q)
 
     g1, g2 = loss(1), loss(2)
@@ -442,10 +443,10 @@ def test_zigzag_flash_head_fold_matches(rng):
     q = jnp.asarray(rng.standard_normal((B, H, D)).astype(np.float32))
 
     def run(a):
-        shm = jax.shard_map(
+        shm = shard_map_compat(
             lambda x, b, c: zigzag_ring_flash_attention_kernel(
                 x, b, c, ax), mesh=mesh, in_specs=(P(ax),) * 3,
-            out_specs=P(ax), check_vma=False)
+            out_specs=P(ax), check=False)
         return shm(a, q, q)
 
     autotune.clear()

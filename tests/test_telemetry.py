@@ -138,14 +138,17 @@ def test_disabled_mode_zero_events_near_zero_overhead(telemetry_capture,
 
 def test_reshard_byte_accounting_known_copyto(telemetry_capture):
     tm = telemetry_capture
-    # 1-D (row) layout → 2-D-ish relayout via copyto_: one reshard of
-    # exactly the payload size (16*8 float32 = 512 bytes)
+    # row layout → column layout via copyto_: ONE reshard whose recorded
+    # bytes are the planner's MOVED bytes — the (p-1)/p fraction that must
+    # cross a device boundary in an even p-way repartition — not the whole
+    # 16*8*4-byte array (the pre-planner accounting)
     src = dat.distribute(np.zeros((16, 8), np.float32), dist=(8, 1))
     dest = dat.dzeros((16, 8), dist=(1, 8))
     ops0 = tm.report()["comm"]["by_kind"].get("reshard", {}).get("ops", 0)
     b0 = tm.comm_bytes("reshard")
     dat.copyto_(dest, src)
-    assert tm.comm_bytes("reshard") - b0 == 16 * 8 * 4
+    total = 16 * 8 * 4
+    assert tm.comm_bytes("reshard") - b0 == total * 7 // 8
     by_kind = tm.report()["comm"]["by_kind"]
     assert by_kind["reshard"]["ops"] - ops0 == 1
     assert tm.counter_value("op.copyto_") == 1
@@ -642,6 +645,11 @@ B = dat.distribute(np.ones((8, 8), dtype=np.float32))
 C = A @ B
 dest = dat.dzeros((8, 8), dist=(1, 8))
 dat.copyto_(dest, C)
+# an eligible single-axis repartition: compiles the planner's chunked
+# collective program (journals a jit build + a reshard plan event)
+E = dat.distribute(np.arange(64, dtype=np.float32).reshape(8, 8), dist=(8, 1))
+F = dat.dzeros((8, 8), dist=(1, 8))
+dat.copyto_(F, E)
 g = dat.gather(dest)
 with tempfile.TemporaryDirectory() as td:
     checkpoint.save(td + "/ckpt", {"d": dest})
@@ -670,7 +678,7 @@ def test_scripted_workload_acceptance(tmp_path):
     # at least one journal event per instrumented category the workload
     # exercises: communication, jit builds, mesh builds, autotune lookups
     cats = rep["events"]["by_category"]
-    for cat in ("comm", "jit", "mesh", "autotune"):
+    for cat in ("comm", "jit", "mesh", "autotune", "reshard"):
         assert cats.get(cat, 0) >= 1, (cat, cats)
     # the journal file round-trips through the summarizer
     s = summarize(read_journal(str(jpath)))

@@ -16,6 +16,7 @@ import numpy as np
 import pytest
 
 import jax
+from distributedarrays_tpu.parallel.collectives import shard_map_compat
 import jax.numpy as jnp
 
 if os.environ.get("DAT_TEST_TPU") != "1":  # pragma: no cover
@@ -152,10 +153,10 @@ def test_ring_flash_differentiable_compiled():
     q = jax.random.normal(jax.random.key(5), (S, H, D), jnp.float32)
     mesh = L.mesh_for([0], (1, 1, 1))
     ax = mesh.axis_names[0]
-    shm = jax.shard_map(
+    shm = shard_map_compat(
         lambda a, b, c: ring_flash_attention_kernel(a, b, c, ax,
                                                     causal=True),
-        mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax), check_vma=False)
+        mesh=mesh, in_specs=(P(ax),) * 3, out_specs=P(ax), check=False)
     g = jax.jit(jax.grad(lambda x: jnp.sum(shm(x, x, x) ** 2)))(q)
     sc = float(1.0 / np.sqrt(D))
     gd = jax.grad(lambda x: jnp.sum(

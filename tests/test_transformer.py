@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from distributedarrays_tpu.models import transformer as T
+from distributedarrays_tpu.parallel.collectives import shard_map_compat
 from distributedarrays_tpu.models.mlp import make_mesh
 from distributedarrays_tpu.ops.pallas_attention import (_dense_attention_shd,
                                                         flash_attention)
@@ -139,10 +140,10 @@ def _sp_dense_forward(cfg, params, tokens):
 
 def test_sp_transformer_forward_matches_dense(sp_setup):
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
-    fwd = jax.jit(jax.shard_map(
+    fwd = jax.jit(shard_map_compat(
         lambda pr, t: SPT.forward_local(pr, t, cfg, "p"),
         mesh=mesh, in_specs=(SPT.param_specs(cfg, "p"), P(None, "p")),
-        out_specs=P(None, "p"), check_vma=False))
+        out_specs=P(None, "p"), check=False))
     got = np.asarray(fwd(params, tokens))
     want = np.asarray(_sp_dense_forward(cfg, params, tokens))
     assert np.abs(got - want).max() / np.abs(want).max() < 1e-4
@@ -222,10 +223,10 @@ def test_sp_transformer_max_seq_guard(sp_setup):
                          interpret=True)
     sp = SPT.init_params(jax.random.key(0), small)
     with pytest.raises(ValueError, match="max_seq"):
-        jax.shard_map(
+        shard_map_compat(
             lambda pr, t: SPT.forward_local(pr, t, small, "p"),
             mesh=mesh, in_specs=(SPT.param_specs(small, "p"), P(None, "p")),
-            out_specs=P(None, "p"), check_vma=False)(sp, tokens)
+            out_specs=P(None, "p"), check=False)(sp, tokens)
 
 
 def test_sp_transformer_zigzag_matches_dense(sp_setup):
@@ -239,10 +240,10 @@ def test_sp_transformer_zigzag_matches_dense(sp_setup):
                         interpret=True, zigzag=True)
     perm = np.asarray(zigzag_order(32, p))
     zz_tokens = jnp.asarray(np.asarray(tokens)[:, perm])
-    fwd = jax.jit(jax.shard_map(
+    fwd = jax.jit(shard_map_compat(
         lambda pr, t: SPT.forward_local(pr, t, zcfg, "p"),
         mesh=mesh, in_specs=(SPT.param_specs(zcfg, "p"), P(None, "p")),
-        out_specs=P(None, "p"), check_vma=False))
+        out_specs=P(None, "p"), check=False))
     got = np.asarray(fwd(params, zz_tokens))[:, np.argsort(perm)]
     want = np.asarray(_sp_dense_forward(zcfg, params, tokens))
     assert np.abs(got - want).max() / np.abs(want).max() < 1e-4
@@ -297,7 +298,7 @@ def test_sp_transformer_checkpoint_roundtrip(sp_setup, tmp_path):
 def test_sp_transformer_update_matches_dense_sgd(sp_setup):
     # one train step == dense value_and_grad SGD step, and every
     # REPLICATED param's device copies stay bit-identical after the
-    # update (regression: check_vma=False means the train step must
+    # update (regression: check=False means the train step must
     # psum replicated-param grads itself; without it the copies diverge
     # and shard 0 hides it)
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
