@@ -275,20 +275,27 @@ def _comm_bytes_now():
         return 0
 
 
-def _span_wrapped(label, fn):
+def _span_wrapped(label, fn, stats=None):
     """Run a config under a ``bench.config`` telemetry span so the
     journal's comm/span events are attributable per bench label.  The
     span opens INSIDE the worker thread that executes ``fn`` (contextvar
-    spans do not cross threads).  Imported lazily like
-    ``_comm_bytes_now``; degrades to the bare fn if telemetry is
-    unavailable."""
+    spans do not cross threads) — and so does the HBM-ledger watermark
+    read: the peak is reset per config and sampled into ``stats`` right
+    after ``fn`` returns, before any later config can move it.  Imported
+    lazily like ``_comm_bytes_now``; degrades to the bare fn if
+    telemetry is unavailable."""
     def run():
         try:
             from distributedarrays_tpu import telemetry
+            from distributedarrays_tpu.telemetry import memory as _mem
         except Exception:
             return fn()
+        _mem.reset_peak()
         with telemetry.span("bench.config", label=label):
-            return fn()
+            res = fn()
+        if stats is not None:
+            stats["hbm_peak_mb"] = round(_mem.peak_bytes() / 2 ** 20, 3)
+        return res
     return run
 
 
@@ -391,7 +398,8 @@ def _guarded(details, label, fn, timeout_s=420.0):
                   f"{label}_orphan_running"):
         details.pop(stale, None)
     comm0 = _comm_bytes_now()
-    fn = _span_wrapped(label, fn)
+    worker_stats: dict = {}
+    fn = _span_wrapped(label, fn, worker_stats)
     effective = min(timeout_s * _TSCALE, _remaining())
     finished, res, thread = _run_with_timeout(fn, effective)
     if finished and isinstance(res, Exception) and \
@@ -426,6 +434,12 @@ def _guarded(details, label, fn, timeout_s=420.0):
         # inflate every later label's delta.
         if not _COMM_TAINTED:
             details[f"{label}_comm_bytes_est"] = _comm_bytes_now() - comm0
+            # HBM watermark column: the ledger peak over this config's
+            # run (reset + read inside the worker thread) — same taint
+            # rule as the comm column: an orphaned config's allocations
+            # would inflate later labels' watermarks
+            if "hbm_peak_mb" in worker_stats:
+                details[f"{label}_hbm_peak_mb"] = worker_stats["hbm_peak_mb"]
     _save(details)
 
 

@@ -171,6 +171,15 @@ class DivergenceChecker:
             # at the moment of divergence (error path — cost irrelevant)
             _tm.event("divergence", "mismatch", index=index, why=why,
                       ranks=len(self.pids))
+            # and the flight recorder dumps ONE postmortem bundle the
+            # moment the divergence is detected — even if the caller
+            # swallows the error before the spmd driver re-raises it
+            # (record_crash dedups on the exception object, so the
+            # driver's own crash hook won't bundle it twice)
+            try:
+                _tm.flight.record_crash(self.error, where="divergence")
+            except Exception:
+                pass
         if self._on_mismatch is not None:
             self._on_mismatch()
         raise self.error

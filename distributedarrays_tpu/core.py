@@ -22,6 +22,8 @@ import itertools
 import threading
 import weakref
 
+from . import telemetry as _tm
+
 __all__ = ["next_did", "d_closeall", "close", "registry", "live_ids", "procs"]
 
 _id_counter = itertools.count(1)
@@ -36,9 +38,12 @@ def current_rank() -> int:
     return getattr(_rank_tls, "rank", 0)
 
 # id -> weakref.ref(DArray).  Mirrors the reference REGISTRY (core.jl:1-28);
-# the lock mirrors its ReentrantLock discipline.
+# the lock mirrors its ReentrantLock discipline — and must genuinely be
+# reentrant here: the flight recorder's SIGUSR1 handler snapshots the
+# registry census on whatever thread the signal interrupts, possibly one
+# already inside register/unregister/d_closeall.
 _registry: dict[tuple[int, int], "weakref.ref"] = {}
-_registry_lock = threading.Lock()
+_registry_lock = threading.RLock()
 
 
 def next_did() -> tuple[int, int]:
@@ -78,19 +83,61 @@ def close(d) -> None:
 
 
 def d_closeall() -> None:
-    """Close every live DArray (reference ``d_closeall``, core.jl:95-103)."""
+    """Close every live DArray (reference ``d_closeall``, core.jl:95-103).
+
+    The registry is cleared BEFORE the close loop, so a ``_close()`` that
+    raises must not strand the remaining (now-unregistered) arrays with
+    their HBM pinned: every array is closed regardless, the FIRST error
+    is re-raised at the end, and the whole sweep is journaled as one
+    ``lifecycle``/``closeall`` event with the closed count and the bytes
+    the HBM ledger saw drain."""
     with _registry_lock:
         refs = list(_registry.values())
         _registry.clear()
+    live0 = _tm.memory.live_bytes() if _tm.enabled() else 0
+    first: BaseException | None = None
+    closed = failed = 0
     for r in refs:
         d = r()
-        if d is not None:
+        if d is None:
+            continue
+        try:
             d._close(_unregister=False)
+            closed += 1
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            failed += 1
+            if first is None:
+                first = e
+    if _tm.enabled():
+        _tm.event("lifecycle", "closeall", closed=closed, errors=failed,
+                  freed_bytes=max(live0 - _tm.memory.live_bytes(), 0))
+    if first is not None:
+        raise first
 
 
 def procs(d):
     """Process/rank grid of ``d`` (reference ``procs(::DArray)``, core.jl:112)."""
     return d.pids
+
+
+def _registry_census() -> dict:
+    """Live-registry snapshot for flight-recorder bundles: how many
+    arrays were open at crash time, and which (id/type/dims/closed)."""
+    snap = registry()
+    items = []
+    for did in sorted(snap):
+        d = snap[did]()
+        if d is None:
+            continue
+        items.append({"id": list(did), "type": type(d).__name__,
+                      "dims": [int(x) for x in getattr(d, "dims", ()) or ()],
+                      "closed": bool(getattr(d, "_closed", False))})
+    return {"live": len(items), "arrays": items[:200]}
+
+
+# telemetry stays package-independent: the bundle's registry census is
+# injected from here instead of imported from there
+_tm.flight.register_census_provider(_registry_census)
 
 
 # ---------------------------------------------------------------------------

@@ -286,6 +286,21 @@ def _share_buffer(src: "DArray", dst: "DArray") -> None:
             src._shared = tok
         tok.count += 1
         dst._shared = tok
+    # HBM ledger mirrors the group: the shared bytes are counted ONCE
+    # (dst's ctor-tracked duplicate entry is dissolved into src's) and
+    # released only when the last co-owner closes
+    _tm.memory.share(src.id, dst.id)
+
+
+def _finalize_darray(did):
+    """Finalizer body: registry AND ledger stay tidy when a DArray is
+    collected without an explicit close (refcounting already freed the
+    HBM; the ledger entry must follow it)."""
+    core.unregister(did)
+    try:
+        _tm.memory.untrack(did)
+    except Exception:  # pragma: no cover — interpreter-shutdown safety
+        pass
 
 
 class DArray:
@@ -365,10 +380,12 @@ class DArray:
         # disjoint chunks in separate processes, here they share one buffer
         self._mutlock = threading.Lock()
         core.register(self)
+        if _tm.enabled():
+            _tm.memory.track(self.id, self._data, site="ctor")
         # finalizer → close_by_id fan-out in the reference (darray.jl:47-49);
-        # here plain refcounting already frees HBM, the finalizer only
-        # keeps the registry tidy.
-        weakref.finalize(self, core.unregister, self.id)
+        # here plain refcounting already frees HBM, the finalizer keeps
+        # the registry and the HBM ledger tidy.
+        weakref.finalize(self, _finalize_darray, self.id)
 
     # -- basic protocol ----------------------------------------------------
 
@@ -469,6 +486,10 @@ class DArray:
             self._closed = True
             sh = self._shared
             self._shared = None
+            # ledger release first (always runs — the ledger must drain
+            # even if telemetry was disabled mid-run); bytes are freed
+            # only when this was the entry's last co-owner
+            _tm.memory.untrack(self.id)
             if sh is None or sh.release(self._data):
                 try:
                     self._data.delete()
@@ -499,6 +520,7 @@ class DArray:
         used when buffer ownership moved into another DArray."""
         self._closed = True
         self._data = None
+        _tm.memory.untrack(self.id)
         core.unregister(self.id)
 
     # -- layout queries ----------------------------------------------------
@@ -601,6 +623,8 @@ class DArray:
                     g2 = jax.device_put(g2, self._psharding)  # dalint: disable=DAL007 — padded-buffer placement restore, not a cross-layout reshard
                 self._leave_share()
                 self._data = g2
+                if _tm.enabled():
+                    _tm.memory.track(self.id, g2, site="set_localpart")
             return
         sl = tuple(slice(r.start, r.stop) for r in idx)
         self._mutate(lambda g: g.at[sl].set(value))
@@ -718,6 +742,8 @@ class DArray:
                     g2 = jax.device_put(g2, self._psharding)  # dalint: disable=DAL007 — padded-buffer placement restore, not a cross-layout reshard
                 self._leave_share()
                 self._data = g2
+                if _tm.enabled():
+                    _tm.memory.track(self.id, g2, site="mutate")
 
     def _rebind(self, new_data: jax.Array):
         """Swap the backing buffer in place (mutation-API support).
@@ -734,6 +760,8 @@ class DArray:
                                     op="blocked_pad", shape=list(self.dims))
                 self._data = _blocked_pad_jit(_cuts_key(self.cuts),
                                               self._psharding)(new_data)
+            if _tm.enabled():
+                _tm.memory.track(self.id, self._data, site="rebind")
             return
         if new_data.sharding != self._sharding:
             # planner-routed: repeated same-layout-pair rebinds hit the
@@ -742,6 +770,8 @@ class DArray:
             from .parallel import reshard as _rs
             new_data = _rs.reshard(new_data, self._sharding, op="rebind")
         self._data = new_data
+        if _tm.enabled():
+            _tm.memory.track(self.id, new_data, site="rebind")
 
     def with_data(self, new_data: jax.Array, did=None) -> "DArray":
         """New DArray with this layout and ``new_data`` (same global shape)."""
@@ -875,6 +905,8 @@ class DArray:
                 self._data = _blocked_filler(
                     "fill", _cuts_key(self.cuts), np.dtype(self.dtype),
                     self._psharding)(jnp.asarray(x, dtype=self.dtype))
+                if _tm.enabled():
+                    _tm.memory.track(self.id, self._data, site="fill_")
             return self
         sh = self._sharding
         self._rebind(_filler("fill", self.dims, np.dtype(self.dtype), sh)(
@@ -891,6 +923,8 @@ class DArray:
                 self._data = _blocked_filler(
                     "rand", _cuts_key(self.cuts), np.dtype(self.dtype),
                     self._psharding)(_next_key())
+                if _tm.enabled():
+                    _tm.memory.track(self.id, self._data, site="rand_")
             return self
         self._rebind(_filler("rand", self.dims, np.dtype(self.dtype),
                              self._sharding)(_next_key()))

@@ -260,7 +260,14 @@ def djit(fn: Callable) -> Callable:
         raw = [(a.garray if isinstance(a, DArray) else
                 a.materialize() if isinstance(a, SubDArray) else a)
                for a in args]
-        res = jfn(*raw, **kwargs)
+        try:
+            res = jfn(*raw, **kwargs)
+        except Exception as e:
+            # flight recorder: a crashed compiled program leaves a
+            # postmortem bundle (ring + open spans + HBM ledger)
+            if _tm.enabled():
+                _tm.flight.record_crash(e, where="djit")
+            raise
 
         def wrap(r):
             if isinstance(r, jax.Array) and r.ndim > 0:

@@ -471,7 +471,17 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
     with _tm.span("reshard", op=op, strategy=plan.strategy):
         if plan.collective:
             try:
-                out = _run_collective(x, dst_sharding, plan)
+                # staging high-water: one chunk piece of the local shard
+                # is what the chunked lowering stages per device.  This
+                # is PLAN-DERIVED (XLA's internal staging buffers are not
+                # jax-observable) — it audits the chunking the planner
+                # actually chose (nchunks) against the
+                # DA_TPU_RESHARD_CHUNK_MB budget, catching selection
+                # regressions, not compiled-program memory use
+                local = plan.total_bytes // max(plan.nparts, 1)
+                piece = -(-local // max(plan.nchunks, 1))
+                with _tm.memory.staging(f"reshard.{plan.strategy}", piece):
+                    out = _run_collective(x, dst_sharding, plan)
                 if _tm.enabled():
                     _tm.record_comm("reshard", plan.moved_bytes, op=op,
                                     strategy=plan.strategy,

@@ -51,6 +51,18 @@ _DEFAULT_TIMEOUT = 60.0  # seconds; a stuck collective fails loudly, not forever
 _PEER_ABORT = "SPMD peer task failed; aborting receive"
 
 
+def _record_crash(exc) -> None:
+    """Flight-recorder trigger on the spmd failure paths: a crashed run
+    leaves one postmortem bundle (ring + open spans + HBM ledger +
+    registry census).  Single boolean check when telemetry is off; the
+    recorder must never mask the real error."""
+    if _tm.enabled():
+        try:
+            _tm.flight.record_crash(exc, where="spmd")
+        except Exception:
+            pass
+
+
 def _scan_stash(msgs: list, match: Callable[[tuple], bool]):
     """Pop and return the first stashed message satisfying ``match``
     (out-of-order buffering, reference spmd.jl:126-143), else None.
@@ -409,7 +421,8 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
         from .spmd_process import run_spmd_process
         try:
             res = run_spmd_process(f, args, ctx, timeout)
-        except BaseException:
+        except BaseException as e:
+            _record_crash(e)
             if not implicit:
                 ctx._reset_comm()    # same post-failure hygiene as threads
             raise
@@ -458,8 +471,10 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
                 ctx._failed.set()      # wake blocked receivers
                 for t2 in threads:
                     t2.join(5)
-                raise TimeoutError(
+                err = TimeoutError(
                     f"spmd task {t.name} did not finish in {timeout}s")
+                _record_crash(err)
+                raise err
     finally:
         ctx._divergence = None
         if implicit:
@@ -481,12 +496,14 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
             # the divergence IS the root cause: every other failure is a
             # peer abort/timeout it triggered.  Raise it directly so the
             # per-rank sequence diff reaches the caller unwrapped.
+            _record_crash(checker.error)
             raise checker.error
         # prefer the root-cause failure over secondary "peer failed" aborts
         primary = [(r, e) for r, e in sorted(errors.items())
                    if not (isinstance(e, RuntimeError)
                            and "peer task failed" in str(e))]
         rank, err = primary[0] if primary else sorted(errors.items())[0]
+        _record_crash(err)
         raise RuntimeError(
             f"spmd task on rank {rank} failed ({len(errors)} total failures)"
         ) from err

@@ -41,8 +41,12 @@ from .core import (enabled, enable, disable, configure, reset, count,
                    report, dump)
 from .summarize import read_journal, summarize, format_summary
 from .tracing import (Span, span, traced, current_span, current_span_id,
-                      spans, span_stats)
+                      spans, span_stats, open_spans)
 from .export import to_perfetto, to_prometheus
+from . import memory
+from . import flight
+from .memory import leak_census
+from .flight import postmortem, record_crash
 
 __all__ = [
     "enabled", "enable", "disable", "configure", "reset",
@@ -51,5 +55,6 @@ __all__ = [
     "journal_path", "nbytes_of", "report", "dump",
     "read_journal", "summarize", "format_summary",
     "Span", "span", "traced", "current_span", "current_span_id",
-    "spans", "span_stats", "to_perfetto", "to_prometheus",
+    "spans", "span_stats", "open_spans", "to_perfetto", "to_prometheus",
+    "memory", "flight", "leak_census", "postmortem", "record_crash",
 ]

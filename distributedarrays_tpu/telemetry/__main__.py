@@ -1,23 +1,34 @@
-"""CLI: summarize or export a telemetry journal / report.
+"""CLI: summarize or export a telemetry journal / report / bundle.
 
 Usage::
 
     python -m distributedarrays_tpu.telemetry summarize RUN.jsonl [--json]
     python -m distributedarrays_tpu.telemetry trace RUN.jsonl [-o out.json]
     python -m distributedarrays_tpu.telemetry prom REPORT.json [-o out.prom]
+    python -m distributedarrays_tpu.telemetry mem RUN.jsonl|REPORT.json [--json]
+    python -m distributedarrays_tpu.telemetry postmortem BUNDLE.json [--json]
     python -m distributedarrays_tpu.telemetry RUN.jsonl [--json]   # legacy
 
-``summarize`` prints event counts by category, communication bytes by
-kind (eager vs traced), span rollups, and top fallback keys; ``trace``
-converts a journal to Perfetto/Chrome trace-event JSON (open at
-ui.perfetto.dev); ``prom`` renders a ``telemetry.dump()`` report — or,
-given a journal, the registry reconstructed from it — in Prometheus
-text exposition format.  ``-`` reads stdin.  The first form without a
-subcommand is the PR-1 interface and behaves exactly like ``summarize``.
+``summarize`` prints event counts by category (grouped per host when the
+journal spans more than one), communication bytes by kind (eager vs
+traced), span rollups, and top fallback keys; ``trace`` converts a
+journal to Perfetto/Chrome trace-event JSON (open at ui.perfetto.dev) —
+including an ``hbm_bytes`` counter track; ``prom`` renders a
+``telemetry.dump()`` report — or, given a journal, the registry
+reconstructed from it — in Prometheus text exposition format; ``mem``
+renders the HBM-ledger view (live/peak bytes, per-device when given a
+report, the alloc/free timeline reconstruction when given a journal);
+``postmortem`` renders a flight-recorder bundle.  ``-`` reads stdin.
+The first form without a subcommand is the PR-1 interface and behaves
+exactly like ``summarize``.
 
-The converters (``summarize.py``, ``export.py``) are pure stdlib;
-running via ``-m`` imports the parent package (JAX present), so on a
-JAX-less machine import those modules directly instead.
+A missing, empty, or size-cap-truncated journal exits with a one-line
+message and status 2 (the cap message carries the ``journal.capped``
+latch fields so the truncation is visible) instead of a traceback.
+
+The converters (``summarize.py``, ``export.py``, ``memory.py``) are pure
+stdlib; running via ``-m`` imports the parent package (JAX present), so
+on a JAX-less machine import those modules directly instead.
 """
 
 from __future__ import annotations
@@ -28,11 +39,35 @@ import json
 import sys
 
 from .export import to_perfetto, to_prometheus
-from .summarize import read_journal, summarize, format_summary
+from .summarize import read_journal, summarize, format_summary, _fmt_bytes
 
 
 def _read_events(path: str) -> list[dict]:
     return read_journal(sys.stdin if path == "-" else path)
+
+
+class _JournalUnusable(Exception):
+    """One-line diagnostic; the CLI prints it and exits 2."""
+
+
+def _check_events(events: list[dict], path: str) -> list[dict]:
+    if not events:
+        raise _JournalUnusable(f"journal is empty: {path}")
+    cap = next((e for e in events
+                if e.get("cat") == "journal" and e.get("name") == "capped"),
+               None)
+    if cap is not None:
+        raise _JournalUnusable(
+            f"journal is cap-truncated: {path} stopped at "
+            f"{cap.get('bytes_written', '?')} bytes "
+            f"(max {cap.get('max_bytes', '?')}; journal.capped at "
+            f"t={cap.get('t', '?')}) — raise "
+            f"DA_TPU_TELEMETRY_JOURNAL_MAX_MB and rerun")
+    return events
+
+
+def _read_events_checked(path: str) -> list[dict]:
+    return _check_events(_read_events(path), path)
 
 
 def _write_out(text: str, out_path: str | None) -> None:
@@ -44,7 +79,7 @@ def _write_out(text: str, out_path: str | None) -> None:
 
 
 def _cmd_summarize(args) -> int:
-    s = summarize(_read_events(args.journal))
+    s = summarize(_read_events_checked(args.journal))
     if args.json:
         json.dump(s, sys.stdout, indent=2, sort_keys=True)
         sys.stdout.write("\n")
@@ -54,7 +89,7 @@ def _cmd_summarize(args) -> int:
 
 
 def _cmd_trace(args) -> int:
-    trace = to_perfetto(_read_events(args.journal))
+    trace = to_perfetto(_read_events_checked(args.journal))
     _write_out(json.dumps(trace, indent=None if args.out else 2) + "\n",
                args.out)
     return 0
@@ -76,6 +111,7 @@ def _registry_from_journal(events: list[dict]) -> dict:
                                   "total_s": v["total_s"],
                                   "self_s": 0.0, "bytes": v["bytes"]}
                               for k, v in s["spans"].items()}},
+        "memory": _mem_from_journal(events),
         "events": {"recorded": s["events"]},
     }
 
@@ -90,15 +126,160 @@ def _cmd_prom(args) -> int:
     if isinstance(doc, dict) and "counters" in doc:
         registry = doc                      # a telemetry.dump() report
     else:                                   # a JSONL journal
-        events = read_journal(io.StringIO(raw))
+        events = _check_events(read_journal(io.StringIO(raw)), args.report)
         registry = _registry_from_journal(events)
     _write_out(to_prometheus(registry), args.out)
     return 0
 
 
+# ---------------------------------------------------------------------------
+# mem: the HBM-ledger view
+# ---------------------------------------------------------------------------
+
+
+def _mem_from_journal(events: list[dict]) -> dict:
+    """Reconstruct the ledger timeline from a journal's ``hbm`` events:
+    final/peak live bytes, alloc/free counts, staging peaks per tag,
+    and top allocation sites by bytes allocated."""
+    live = peak = allocs = frees = 0
+    staging_peak = 0
+    staging_tags: dict[str, int] = {}
+    sites: dict[str, dict] = {}
+    for e in events:
+        if e.get("cat") != "hbm":
+            continue
+        name = e.get("name")
+        if e.get("live") is not None:
+            live = int(e["live"])
+            peak = max(peak, live)
+        if name == "alloc":
+            allocs += 1
+            site = str(e.get("site") or "?")
+            s = sites.setdefault(site, {"bytes": 0, "count": 0})
+            s["bytes"] += int(e.get("bytes", 0) or 0)
+            s["count"] += 1
+        elif name == "free":
+            frees += 1
+        elif name == "staging":
+            sl = int(e.get("staging_live", 0) or 0)
+            staging_peak = max(staging_peak, sl)
+            tag = str(e.get("tag") or "?")
+            staging_tags[tag] = max(staging_tags.get(tag, 0), sl)
+    return {
+        "live_bytes": live, "peak_bytes": peak,
+        "allocs": allocs, "frees": frees,
+        "staging": {"peak_bytes": staging_peak,
+                    "peak_by_tag": dict(sorted(staging_tags.items()))},
+        "top_sites": sorted(([k, v["bytes"], v["count"]]
+                             for k, v in sites.items()),
+                            key=lambda kv: -kv[1])[:10],
+    }
+
+
+def _format_mem(mem: dict, out) -> None:
+    out.write(f"hbm live:  {_fmt_bytes(mem.get('live_bytes', 0))}\n")
+    out.write(f"hbm peak:  {_fmt_bytes(mem.get('peak_bytes', 0))}\n")
+    if "tracked_arrays" in mem:
+        out.write(f"tracked arrays: {mem['tracked_arrays']}\n")
+    if "allocs" in mem:
+        out.write(f"allocs/frees:   {mem['allocs']}/{mem['frees']}\n")
+    by_dev = mem.get("by_device") or {}
+    if by_dev:
+        out.write("per device:\n")
+        for dev, d in sorted(by_dev.items()):
+            out.write(f"  dev {dev:<6} live {_fmt_bytes(d['live_bytes']):>12}"
+                      f"  peak {_fmt_bytes(d['peak_bytes']):>12}\n")
+    st = mem.get("staging") or {}
+    if st:
+        out.write(f"staging peak: {_fmt_bytes(st.get('peak_bytes', 0))}\n")
+        for tag, v in (st.get("peak_by_tag") or {}).items():
+            out.write(f"  {tag:<28} {_fmt_bytes(v)}\n")
+    sites = mem.get("top_sites") or []
+    if sites:
+        out.write("top allocation sites:\n")
+        for site, b, n in sites:
+            out.write(f"  {site:<28} {n:>5} x  {_fmt_bytes(b)}\n")
+
+
+def _cmd_mem(args) -> int:
+    raw = sys.stdin.read() if args.input == "-" else open(args.input).read()
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "memory" in doc:
+        mem = doc["memory"]                  # a telemetry.dump() report
+    elif isinstance(doc, dict) and "live_bytes" in doc:
+        mem = doc                            # a bare memory section
+    else:                                    # a JSONL journal
+        events = _check_events(read_journal(io.StringIO(raw)), args.input)
+        mem = _mem_from_journal(events)
+    if args.json:
+        json.dump(mem, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        _format_mem(mem, sys.stdout)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# postmortem: render a flight-recorder bundle
+# ---------------------------------------------------------------------------
+
+
+def _cmd_postmortem(args) -> int:
+    raw = sys.stdin.read() if args.bundle == "-" else open(args.bundle).read()
+    try:
+        b = json.loads(raw)
+    except ValueError:
+        print(f"not a postmortem bundle (invalid JSON): {args.bundle}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(b, dict) or b.get("kind") != "da_tpu_postmortem":
+        print(f"not a postmortem bundle: {args.bundle}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(b, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    out = sys.stdout
+    out.write(f"postmortem: {b.get('reason')}  "
+              f"(host {b.get('host')}, pid {b.get('pid')}, "
+              f"t={b.get('t')}s)\n")
+    exc = b.get("exception")
+    if exc:
+        out.write(f"exception: {exc.get('type')}: "
+                  f"{str(exc.get('message', ''))[:500]}\n")
+    opens = b.get("open_spans") or []
+    out.write(f"\nopen spans at crash ({len(opens)}):\n")
+    for s in opens:
+        out.write(f"  {s.get('name'):<28} id={s.get('span_id')} "
+                  f"tname={s.get('tname')}\n")
+    _format_mem(b.get("ledger") or {}, out)
+    census = b.get("registry_census") or {}
+    out.write(f"\nregistry census: {census.get('live', '?')} live arrays\n")
+    leak = b.get("leak_census") or {}
+    for klass in ("ledger_tracked", "untracked_foreign",
+                  "deleted_but_registered"):
+        c = leak.get(klass) or {}
+        out.write(f"  {klass:<24} {c.get('count', 0):>5} x  "
+                  f"{_fmt_bytes(c.get('bytes', 0))}\n")
+    div = b.get("divergence") or []
+    if div:
+        out.write(f"\ndivergence events ({len(div)}):\n")
+        for e in div[-5:]:
+            out.write(f"  t={e.get('t')} {e.get('why', '')[:120]}\n")
+    ring = b.get("ring") or []
+    out.write(f"\nevent ring tail ({len(ring)} events, last 10):\n")
+    for e in ring[-10:]:
+        out.write(f"  t={e.get('t')} {e.get('cat')}/{e.get('name')}\n")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] in ("summarize", "trace", "prom"):
+    if argv and argv[0] in ("summarize", "trace", "prom", "mem",
+                            "postmortem"):
         ap = argparse.ArgumentParser(
             prog="python -m distributedarrays_tpu.telemetry",
             description="Summarize or export a telemetry journal/report.")
@@ -121,9 +302,24 @@ def main(argv=None) -> int:
         p.add_argument("-o", "--out", default=None,
                        help="output path (default stdout)")
         p.set_defaults(fn=_cmd_prom)
+        p = sub.add_parser("mem",
+                           help="HBM ledger view of a journal or report")
+        p.add_argument("input", help="journal/report path ('-' = stdin)")
+        p.add_argument("--json", action="store_true",
+                       help="emit the memory section as JSON")
+        p.set_defaults(fn=_cmd_mem)
+        p = sub.add_parser("postmortem",
+                           help="render a flight-recorder bundle")
+        p.add_argument("bundle", help="bundle path ('-' = stdin)")
+        p.add_argument("--json", action="store_true",
+                       help="re-emit the bundle as JSON")
+        p.set_defaults(fn=_cmd_postmortem)
         args = ap.parse_args(argv)
         try:
             return args.fn(args)
+        except _JournalUnusable as e:
+            print(str(e), file=sys.stderr)
+            return 2
         except OSError as e:
             print(f"cannot read input: {e}", file=sys.stderr)
             return 2
@@ -138,6 +334,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     try:
         return _cmd_summarize(args)
+    except _JournalUnusable as e:
+        print(str(e), file=sys.stderr)
+        return 2
     except OSError as e:
         print(f"cannot read journal: {e}", file=sys.stderr)
         return 2

@@ -79,6 +79,14 @@ _journal_capped = False    # True once the size cap stopped file mirroring
 # one monotonic origin per process so every event timestamp is comparable
 _T0 = time.monotonic()
 
+# host identity stamped on every journal event (and postmortem bundle) so
+# multihost journals can be merged and re-grouped per host offline
+try:
+    import socket as _socket
+    _HOST = _socket.gethostname() or "unknown"
+except Exception:  # pragma: no cover
+    _HOST = "unknown"
+
 # the innermost open tracing span (telemetry/tracing.py) on this
 # thread/context — read here so events and comm records are stamped with
 # the span they happened under.  A ContextVar, not thread-local: tasks
@@ -283,7 +291,9 @@ def event(category: str, name: str | None = None, *,
                "t": round(time.monotonic() - _T0, 6),
                "wall": round(time.time(), 3),
                "cat": category,
-               "tid": threading.get_ident()}
+               "tid": threading.get_ident(),
+               "host": _HOST,
+               "pid": os.getpid()}
         if name is not None:
             rec["name"] = name
         if sp is not None and "span_id" not in fields:
@@ -332,6 +342,7 @@ def _write_journal_locked(rec: dict) -> None:
                    "t": round(time.monotonic() - _T0, 6),
                    "wall": round(time.time(), 3),
                    "cat": "journal", "name": "capped",
+                   "host": _HOST, "pid": os.getpid(),
                    "bytes_written": _journal_bytes,
                    "max_bytes": _journal_max}
             _events_total += 1

@@ -20,7 +20,7 @@ import re
 __all__ = ["to_perfetto", "to_prometheus"]
 
 # journal bookkeeping keys that are not user "args" of an event
-_EVENT_META = ("seq", "t", "wall", "cat", "name", "tid")
+_EVENT_META = ("seq", "t", "wall", "cat", "name", "tid", "host", "pid")
 
 
 def _us(seconds) -> float:
@@ -58,6 +58,10 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
                       "ph": "X", "ts": _us(s.get("start", 0.0)),
                       "dur": _us(s["dur"]), "pid": pid, "tid": tid,
                       "args": args})
+    # counter-track state: each "C" event's args define ALL series values
+    # at that timestamp, so the missing series must be carried forward or
+    # the renderer drops its line to zero between samples
+    hbm_live = hbm_staging = 0
     for e in rest:
         tid = int(e.get("tid") or 0)
         cat = str(e.get("cat", "?"))
@@ -68,6 +72,21 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
                       "cat": cat, "ph": "i", "s": "t",
                       "ts": _us(e.get("t", 0.0)), "dur": 0,
                       "pid": pid, "tid": tid, "args": args})
+        if cat == "hbm":
+            # counter ("C") track: the HBM ledger as a line under the
+            # span timeline — ledger live bytes and transient staging
+            # are two series on one counter
+            if e.get("live") is not None or \
+                    e.get("staging_live") is not None:
+                if e.get("live") is not None:
+                    hbm_live = e["live"]
+                if e.get("staging_live") is not None:
+                    hbm_staging = e["staging_live"]
+                trace.append({"name": "hbm_bytes", "cat": "hbm",
+                              "ph": "C", "ts": _us(e.get("t", 0.0)),
+                              "dur": 0, "pid": pid, "tid": 0,
+                              "args": {"live": hbm_live,
+                                       "staging": hbm_staging}})
     for tid, tname in sorted(threads.items()):
         trace.append({"name": "thread_name", "ph": "M", "ts": 0, "dur": 0,
                       "pid": pid, "tid": tid, "args": {"name": tname}})
@@ -200,6 +219,31 @@ def to_prometheus(registry: dict | None = None) -> str:
         fam("da_tpu_span_bytes_total", "counter",
             "comm bytes attributed to spans by name").add(
                 lbl, st.get("bytes", 0))
+    mem = registry.get("memory", {})
+    if mem:
+        fam("da_tpu_hbm_live_bytes", "gauge",
+            "HBM ledger live bytes").add({"device": "all"},
+                                         mem.get("live_bytes", 0))
+        fam("da_tpu_hbm_peak_bytes", "gauge",
+            "HBM ledger peak bytes").add({"device": "all"},
+                                         mem.get("peak_bytes", 0))
+        for dev, d in sorted(mem.get("by_device", {}).items()):
+            fam("da_tpu_hbm_live_bytes", "gauge",
+                "HBM ledger live bytes").add({"device": dev},
+                                             d.get("live_bytes", 0))
+            fam("da_tpu_hbm_peak_bytes", "gauge",
+                "HBM ledger peak bytes").add({"device": dev},
+                                             d.get("peak_bytes", 0))
+        fam("da_tpu_hbm_tracked_arrays", "gauge",
+            "arrays tracked by the HBM ledger").add(
+                {}, mem.get("tracked_arrays", 0))
+        st = mem.get("staging", {})
+        fam("da_tpu_hbm_staging_peak_bytes", "gauge",
+            "peak transient staging bytes").add(
+                {"tag": "all"}, st.get("peak_bytes", 0))
+        for tag, v in sorted(st.get("peak_by_tag", {}).items()):
+            fam("da_tpu_hbm_staging_peak_bytes", "gauge",
+                "peak transient staging bytes").add({"tag": tag}, v)
     ev = registry.get("events", {})
     if ev:
         fam("da_tpu_events_recorded_total", "counter",

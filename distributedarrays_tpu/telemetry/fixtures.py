@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import pytest
 
-from . import core, tracing
+from . import core, memory, tracing
 
 
 class TelemetryCapture:
@@ -57,6 +57,26 @@ class TelemetryCapture:
                 f"expected >= {min_count} span(s) named {name!r}, "
                 f"got {got}; finished span names: {sorted(stats)}")
         return tracing.spans(name)
+
+    def assert_counter(self, name: str, min_count: float = 1,
+                       **labels) -> float:
+        """Assert counter ``name`` (with optional labels) reached at
+        least ``min_count``; returns the observed value.  The failure
+        message lists the recorded counter keys, so a renamed metric is
+        a one-glance fix — replaces hand-rolled ``counter_value``
+        polling in tests."""
+        got = core.counter_value(name, **labels)
+        if got < min_count:
+            with core._LOCK:
+                keys = sorted(core._counters)
+            raise AssertionError(
+                f"expected counter {core._key(name, labels)!r} >= "
+                f"{min_count}, got {got}; recorded counters: {keys}")
+        return got
+
+    def mem(self) -> dict:
+        """Snapshot of the HBM ledger (the ``memory`` report section)."""
+        return memory.snapshot()
 
 
 @pytest.fixture

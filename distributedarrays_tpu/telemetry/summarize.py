@@ -54,10 +54,21 @@ def summarize(events: list[dict]) -> dict:
     comm: dict[str, dict] = {}
     fallbacks: dict[str, int] = {}
     spans: dict[str, dict] = {}
+    # per-host rollups: multihost journals are merged by concatenation
+    # (every event carries host/pid), so the summary re-groups them
+    by_host: dict[str, dict] = {}
     tmin = tmax = None
     for e in events:
         cat = str(e.get("cat", "?"))
         by_cat[cat] = by_cat.get(cat, 0) + 1
+        host = e.get("host")
+        if host is not None:
+            h = by_host.setdefault(str(host), {"events": 0, "comm_bytes": 0,
+                                               "by_category": {}})
+            h["events"] += 1
+            h["by_category"][cat] = h["by_category"].get(cat, 0) + 1
+            if cat == "comm":
+                h["comm_bytes"] += int(e.get("bytes", 0) or 0)
         name = e.get("name")
         if name is not None:
             k = f"{cat}/{name}"
@@ -92,6 +103,8 @@ def summarize(events: list[dict]) -> dict:
         s["total_s"] = round(s["total_s"], 6)
     return {
         "events": len(events),
+        "hosts": sorted(by_host),
+        "by_host": dict(sorted(by_host.items())),
         "span_s": round(tmax - tmin, 6) if tmin is not None else 0.0,
         "by_category": dict(sorted(by_cat.items())),
         "by_name": dict(sorted(by_name.items())),
@@ -120,6 +133,16 @@ def format_summary(summary: dict, out: TextIO) -> None:
     """Render :func:`summarize`'s dict as an aligned text table."""
     out.write(f"events: {summary['events']}  "
               f"(span {summary['span_s']:.3f}s)\n")
+    hosts = summary.get("hosts") or []
+    if len(hosts) > 1:
+        # merged multihost journal: group the tables per host first
+        out.write(f"\nhosts ({len(hosts)}):\n")
+        for host in hosts:
+            h = summary["by_host"][host]
+            cats = ", ".join(f"{c}={n}" for c, n in
+                             sorted(h["by_category"].items()))
+            out.write(f"  {host:<24} {h['events']:>7} events  "
+                      f"{_fmt_bytes(h['comm_bytes'])} comm  [{cats}]\n")
     out.write("\nby category:\n")
     for cat, n in summary["by_category"].items():
         out.write(f"  {cat:<16} {n}\n")

@@ -42,7 +42,7 @@ from collections import deque
 from . import core
 
 __all__ = ["Span", "span", "traced", "current_span", "current_span_id",
-           "spans", "span_stats"]
+           "spans", "span_stats", "open_spans"]
 
 _SPAN_BUFFER_MAX = 8192
 _ids = itertools.count(1)        # CPython-atomic; no lock needed
@@ -50,6 +50,9 @@ _finished: deque = deque(maxlen=_SPAN_BUFFER_MAX)
 _finished_total = 0
 # name -> {count, total_s, self_s, bytes, child_bytes}
 _stats: dict[str, dict] = {}
+# span_id -> Span, for every span currently OPEN on any thread — the
+# flight recorder's "what was in progress when we crashed" snapshot
+_open: dict[int, "Span"] = {}
 
 
 class Span:
@@ -124,6 +127,8 @@ class span:
         sp = Span(self._name, self._labels, parent, self._journal)
         self._tok = core._CURRENT_SPAN.set(sp)
         self._sp = sp
+        with core._LOCK:
+            _open[sp.span_id] = sp
         return sp
 
     def __exit__(self, exc_type, exc, tb):
@@ -140,6 +145,7 @@ def _finish(sp: Span, journal: bool, error: bool = False) -> None:
     global _finished_total
     sp.dur = time.monotonic() - sp._t0
     with core._LOCK:
+        _open.pop(sp.span_id, None)
         parent = sp.parent
         if parent is not None and parent.dur is None:
             # parent still open on this stack: roll this span's time and
@@ -225,6 +231,14 @@ def spans(name: str | None = None) -> list[dict]:
     return [s for s in out if s["name"] == name]
 
 
+def open_spans() -> list[dict]:
+    """Every span currently open on any thread (oldest first) — the
+    flight recorder's in-progress stack.  ``dur`` is None on each."""
+    with core._LOCK:
+        sps = sorted(_open.values(), key=lambda s: s.span_id)
+        return [s.to_dict() for s in sps]
+
+
 def span_stats() -> dict[str, dict]:
     """Per-name aggregates over every finished span: count, total wall
     time, self time (total minus directly-nested child time), own comm
@@ -259,6 +273,7 @@ def _reset() -> None:
     with core._LOCK:
         _finished.clear()
         _stats.clear()
+        _open.clear()
         _finished_total = 0
 
 

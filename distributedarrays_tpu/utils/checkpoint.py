@@ -193,6 +193,8 @@ def save(path: str | os.PathLike, tree: Any, store: str = "npz") -> None:
         _tm.event("checkpoint", "save_end", path=str(path),  # dalint: disable=DAL003
                   store=store, arrays=len(arrays),
                   bytes=int(sum(a.nbytes for a in arrays.values())))
+        # HBM-ledger phase boundary on the Perfetto counter track
+        _tm.memory.sample("checkpoint.save")
 
 
 def load(path: str | os.PathLike) -> Any:
@@ -229,6 +231,8 @@ def load(path: str | os.PathLike) -> Any:
         _tm.event("checkpoint", "restore_end", path=str(path),  # dalint: disable=DAL003
                   store=store, arrays=len(arrays),
                   bytes=int(sum(a.nbytes for a in arrays.values())))
+        # HBM-ledger phase boundary on the Perfetto counter track
+        _tm.memory.sample("checkpoint.restore")
         return out
 
 

@@ -38,7 +38,7 @@ import distributedarrays_tpu as dat
 
 
 @pytest.fixture(autouse=True)
-def _seed_and_leakcheck():
+def _seed_and_leakcheck(request):
     dat.seed(1234)
     yield
     # After the test body returns, its locals are collectable: any DArray the
@@ -52,6 +52,17 @@ def _seed_and_leakcheck():
     assert dat.live_ids() == []
     # real leak check lives in test_leaks.py; here we only flag runaway growth
     assert len(leaked) < 64, f"suspicious registry growth: {len(leaked)} live"
+    # HBM-ledger leak gate: with the registry drained the ledger must be
+    # empty too — a nonzero residue means some lifecycle path swapped or
+    # dropped a buffer without telling the ledger.  Opt out (tests that
+    # leak on purpose) with @pytest.mark.intentional_leak.
+    if "intentional_leak" not in request.keywords:
+        from distributedarrays_tpu.telemetry import memory as _tmem
+        residue = _tmem.live_bytes()
+        assert residue == 0, (
+            f"HBM ledger not drained after d_closeall: {residue} bytes "
+            f"across {_tmem.tracked_count()} entries — "
+            f"{_tmem.entries(limit=5)}")
 
 
 @pytest.fixture
@@ -67,6 +78,10 @@ def pytest_configure(config):
         "markers", "slow: long-running test (property fuzz, training "
         "convergence, subprocess clusters); run with --runslow or "
         "DAT_TEST_SLOW=1 — CI always runs them")
+    config.addinivalue_line(
+        "markers", "intentional_leak: test leaves device buffers "
+        "unaccounted on purpose; skips the per-test HBM-ledger drain "
+        "assertion")
 
 
 def pytest_addoption(parser):

@@ -1,0 +1,632 @@
+"""HBM memory ledger + flight recorder tests.
+
+The ledger must mirror lifecycle truth exactly: physical (shard-sum)
+bytes from constructor to close/finalizer, buffers co-owned through
+``_BufShare`` counted once, rebinds swapping entries in place, and the
+whole thing draining to zero with the registry.  The reconciliation test
+is the acceptance check: ledger live-bytes track ``jax.live_arrays()``
+deltas within 1% at every phase boundary of a scripted workload.  The
+flight recorder must leave exactly one postmortem bundle per crash
+(spmd failure, CollectiveDivergenceError, djit trace error, SIGUSR1,
+on-demand), containing the event ring, open spans, per-device ledger,
+and registry census."""
+
+import gc
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import telemetry
+from distributedarrays_tpu.darray import DArray
+from distributedarrays_tpu.parallel import reshard as R
+from distributedarrays_tpu.telemetry import flight, memory as tmem
+from distributedarrays_tpu.telemetry.fixtures import telemetry_capture  # noqa: F401 (fixture)
+from distributedarrays_tpu.telemetry.summarize import (read_journal,
+                                                       summarize)
+from distributedarrays_tpu.utils import checkpoint
+
+
+def _sharding_for(shape, grid):
+    from distributedarrays_tpu import layout as L
+    return L.sharding_for(list(range(int(np.prod(grid)))), grid, shape)
+
+
+# ---------------------------------------------------------------------------
+# ledger: lifecycle accounting
+# ---------------------------------------------------------------------------
+
+
+def test_ctor_and_close_account_physical_bytes(telemetry_capture):
+    tm = telemetry_capture
+    base = tmem.live_bytes()
+    d = dat.dzeros((64, 64))                      # 16 KiB f32, even layout
+    assert tmem.live_bytes() - base == 64 * 64 * 4
+    # per-device: one shard per device on the 8-device mesh
+    snap = tm.mem()
+    assert len(snap["by_device"]) == 8
+    assert sum(v["live_bytes"] for v in snap["by_device"].values()) == \
+        snap["live_bytes"]
+    d.close()
+    assert tmem.live_bytes() == base
+    # journal carries the alloc/free pair with running live bytes
+    names = [e["name"] for e in tm.events("hbm")]
+    assert "alloc" in names and "free" in names
+
+
+def test_uneven_layout_counts_padded_physical_bytes(telemetry_capture):
+    base = tmem.live_bytes()
+    d = dat.distribute(np.arange(70, dtype=np.float32).reshape(10, 7))
+    # the at-rest buffer is the blocked-padded physical form — the ledger
+    # reports what HBM actually holds, not the logical 280 bytes
+    assert tmem.live_bytes() - base == d.garray_padded.nbytes
+    d.close()
+    assert tmem.live_bytes() == base
+
+
+def test_rebind_swaps_entry_not_duplicates(telemetry_capture):
+    base = tmem.live_bytes()
+    d = dat.dzeros((32, 32))
+    one = tmem.live_bytes() - base
+    d.fill_(3.0)                                   # rebind, same size
+    assert tmem.live_bytes() - base == one
+    d[2:5, :] = 7.0                                # mutate → rebind
+    assert tmem.live_bytes() - base == one
+    d.close()
+    assert tmem.live_bytes() == base
+
+
+def test_bufshare_counted_once_released_by_last_owner(telemetry_capture):
+    base = tmem.live_bytes()
+    a = dat.distribute(np.ones((32, 16), np.float32))
+    nb = a._data.nbytes
+    tmem.reset_peak()
+    b = dat.samedist(a, a)                         # aligned: co-owns a's buf
+    assert b.garray is a.garray
+    assert tmem.live_bytes() - base == nb, \
+        "co-owned buffer must be counted exactly once"
+    # not even TRANSIENTLY double-counted: the dst ctor joins the
+    # existing entry by buffer identity, so the peak watermark for the
+    # zero-copy fast path never sees 2x the buffer
+    assert tmem.peak_bytes() - base <= nb
+    a.close()                                      # first owner leaves
+    assert tmem.live_bytes() - base == nb
+    assert not b.garray.is_deleted()
+    b.close()                                      # last owner frees
+    assert tmem.live_bytes() == base
+
+
+def test_share_then_rebind_departs_group(telemetry_capture):
+    base = tmem.live_bytes()
+    a = dat.distribute(np.ones((32, 16), np.float32))
+    nb = a._data.nbytes
+    b = dat.samedist(a, a)
+    b.fill_(2.0)          # b rebinds to a fresh buffer → two buffers live
+    assert tmem.live_bytes() - base == 2 * nb
+    a.close()
+    b.close()
+    assert tmem.live_bytes() == base
+
+
+def test_finalizer_drains_ledger(telemetry_capture):
+    base = tmem.live_bytes()
+
+    def scope():
+        dat.drand((16, 16))
+    scope()
+    gc.collect()
+    assert tmem.live_bytes() == base
+
+
+def test_allocation_site_attribution(telemetry_capture):
+    tm = telemetry_capture
+    with tm.span("workload.phase1"):
+        d = dat.dzeros((16, 16))
+    ents = tmem.entries()
+    mine = [e for e in ents if list(d.id) in e["owners"]]
+    assert mine, ents
+    assert mine[0]["span"] == "workload.phase1"
+    assert mine[0]["stack"], "truncated stack expected by default"
+    assert any("test_memory.py" in fr for fr in mine[0]["stack"])
+    d.close()
+
+
+def test_peak_watermark_and_reset(telemetry_capture):
+    base = tmem.live_bytes()
+    tmem.reset_peak()
+    d = dat.dzeros((64, 64))
+    d.close()
+    assert tmem.peak_bytes() >= base + 64 * 64 * 4
+    tmem.reset_peak()
+    assert tmem.peak_bytes() == tmem.live_bytes()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: reconciliation against jax.live_arrays()
+# ---------------------------------------------------------------------------
+
+
+def _jax_live_bytes():
+    # physical bytes, deduped by device buffer: jax.live_arrays() lists
+    # a sharded global array AND its per-shard component arrays, which
+    # alias the same device buffers
+    seen = set()
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if a.is_deleted():
+                continue
+            for s in a.addressable_shards:
+                key = (getattr(s.device, "id", None),
+                       s.data.unsafe_buffer_pointer())
+                if key in seen:
+                    continue
+                seen.add(key)
+                total += s.data.nbytes
+        except Exception:
+            total += getattr(a, "nbytes", 0) or 0
+    return total
+
+
+def test_reconciliation_scripted_workload(telemetry_capture, tmp_path, rng):
+    # warm the compile caches with the shapes the workload uses, so jit
+    # constants materialized during the phases don't drift the baseline
+    w = dat.dzeros((256, 256))
+    w.fill_(1.0)
+    dat.copyto_(dat.dzeros((256, 256), dist=(1, 8)), w)
+    dat.d_closeall()
+    gc.collect()
+    base_jax = _jax_live_bytes()
+    base_ledger = tmem.live_bytes()
+    eps = 16 * 1024                                # stray keys/consts slack
+
+    def check_phase(phase):
+        gc.collect()
+        ledger = tmem.live_bytes() - base_ledger
+        delta = _jax_live_bytes() - base_jax
+        tol = max(0.01 * max(ledger, delta), eps)
+        assert abs(ledger - delta) <= tol, \
+            (phase, ledger, delta, telemetry.leak_census())
+
+    # phase 1: constructors
+    a = dat.dzeros((256, 256))                     # 256 KiB
+    b = dat.distribute(rng.standard_normal((256, 256)).astype(np.float32))
+    check_phase("ctors")
+    # phase 2: reshard (divisible single-axis repartition)
+    dest = dat.dzeros((256, 256), dist=(1, 8))
+    dat.copyto_(dest, b)
+    check_phase("reshard")
+    # phase 3: mutate
+    a[10:200, 5:50] = 3.0
+    check_phase("mutate")
+    # phase 4: checkpoint round-trip
+    checkpoint.save(tmp_path / "ckpt", {"a": a})
+    restored = checkpoint.load(tmp_path / "ckpt")["a"]
+    assert isinstance(restored, DArray)
+    check_phase("checkpoint")
+    # phase 5: close everything — the ledger must drain to zero
+    dat.d_closeall()
+    gc.collect()
+    assert tmem.live_bytes() == 0
+    check_phase("closed")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: reshard staging bound observed
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_staging_highwater_within_chunk_bound(telemetry_capture,
+                                                      rng, monkeypatch):
+    # NB: the staging figure is plan-derived (local shard / nchunks), so
+    # this audits the chunking the planner actually CHOSE against the
+    # budget — a regression where _pick_chunking stops chunking (nchunks
+    # collapses to 1) blows the 2x bound and fails here
+    monkeypatch.setenv("DA_TPU_RESHARD_CHUNK_MB", "0.0005")  # 524 bytes
+    target = int(0.0005 * 1024 * 1024)
+    shape = (64, 48)
+    A = rng.standard_normal(shape).astype(np.float32)
+    src, dst = _sharding_for(shape, (8, 1)), _sharding_for(shape, (1, 8))
+    x = jax.device_put(A, src)
+    plan = R.plan_reshard(x, dst)
+    assert plan.strategy == "all_to_all" and plan.nchunks > 1
+    y = R.reshard(x, dst, plan=plan)
+    np.testing.assert_array_equal(np.asarray(y), A)
+    peak = tmem.staging_peak("reshard.all_to_all")
+    assert 0 < peak <= 2 * target, \
+        f"staging high-water {peak} exceeds 2x chunk target {target}"
+    # the staging transient is journaled (Perfetto counter source)
+    evs = [e for e in telemetry.events("hbm") if e.get("name") == "staging"]
+    assert any(e.get("tag") == "reshard.all_to_all" for e in evs)
+    # ...and released: live staging back to zero
+    assert telemetry.report()["memory"]["staging"]["live_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# leak census
+# ---------------------------------------------------------------------------
+
+
+def test_leak_census_classifies_three_ways(telemetry_capture):
+    d = dat.dzeros((32, 32))                       # ledger-tracked
+    foreign = jnp.ones((16, 16))                   # untracked-foreign
+    foreign.block_until_ready()
+    census = telemetry.leak_census()
+    assert census["ledger_tracked"]["count"] >= 1
+    assert census["ledger_tracked"]["bytes"] >= 32 * 32 * 4
+    assert census["untracked_foreign"]["count"] >= 1
+    assert census["deleted_but_registered"] == {"bytes": 0, "count": 0}
+    # now delete the device buffer behind the ledger's back: the census
+    # must flag the entry as deleted-but-registered
+    d._data.delete()
+    census = telemetry.leak_census()
+    assert census["deleted_but_registered"]["count"] == 1
+    assert census["deleted_but_registered"]["bytes"] == 32 * 32 * 4
+    d.close()
+    del foreign
+
+
+# ---------------------------------------------------------------------------
+# satellite: hardened d_closeall
+# ---------------------------------------------------------------------------
+
+
+def test_d_closeall_closes_rest_and_reraises_first(telemetry_capture,
+                                                   monkeypatch):
+    tm = telemetry_capture
+    a = dat.dzeros((8, 8))
+    b = dat.dzeros((8, 8))
+    c = dat.dzeros((8, 8))
+    orig = DArray._close
+
+    def bad_close(self, _unregister=True):
+        if self.id == b.id:
+            raise RuntimeError("boom: close failed")
+        return orig(self, _unregister=_unregister)
+
+    with monkeypatch.context() as mp:
+        mp.setattr(DArray, "_close", bad_close)
+        with pytest.raises(RuntimeError, match="boom"):
+            dat.d_closeall()
+    # the failing array must NOT strand the others: all closed, registry
+    # empty, ledger holds only b's bytes
+    assert a._closed and c._closed and not b._closed
+    assert dat.live_ids() == []
+    assert tmem.live_bytes() == 8 * 8 * 4
+    evs = [e for e in tm.events("lifecycle") if e["name"] == "closeall"]
+    assert evs and evs[-1]["closed"] == 2 and evs[-1]["errors"] == 1
+    assert evs[-1]["freed_bytes"] == 2 * 8 * 8 * 4
+    b.close()                                      # real close drains it
+    assert tmem.live_bytes() == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: host/pid fields + per-host summarize grouping
+# ---------------------------------------------------------------------------
+
+
+def test_events_carry_host_and_pid(telemetry_capture):
+    tm = telemetry_capture
+    tm.event("x", "y")
+    ev = tm.events("x")[0]
+    assert ev["pid"] == os.getpid()
+    assert isinstance(ev["host"], str) and ev["host"]
+
+
+def test_summarize_groups_by_host_when_multihost(telemetry_capture):
+    tm = telemetry_capture
+    tm.event("comm", "reshard", bytes=100)
+    tm.event("comm", "reshard", bytes=50)
+    evs = [dict(e) for e in tm.events()]
+    # simulate a merged multihost journal: second host's events appended
+    merged = evs + [{**e, "host": "other-host", "pid": 999} for e in evs]
+    s = summarize(merged)
+    assert len(s["hosts"]) == 2
+    this = [h for h in s["hosts"] if h != "other-host"][0]
+    assert s["by_host"]["other-host"]["comm_bytes"] == 150
+    assert s["by_host"][this]["comm_bytes"] == 150
+    import io
+    buf = io.StringIO()
+    from distributedarrays_tpu.telemetry.summarize import format_summary
+    format_summary(s, buf)
+    text = buf.getvalue()
+    assert "hosts (2):" in text and "other-host" in text
+    # single-host journals keep the old flat rendering
+    s1 = summarize(evs)
+    assert len(s1["hosts"]) == 1
+    buf = io.StringIO()
+    format_summary(s1, buf)
+    assert "hosts (" not in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_postmortem_on_demand_bundle(telemetry_capture, tmp_path):
+    tm = telemetry_capture
+    d = dat.dzeros((16, 16))
+    tm.event("workload", "marker", step=7)
+    with tm.span("outer"):
+        path = telemetry.postmortem()
+    assert path is not None and os.path.exists(path)
+    b = json.load(open(path))
+    assert b["kind"] == "da_tpu_postmortem"
+    assert b["reason"] == "on_demand"
+    assert b["host"] and b["pid"] == os.getpid()
+    # ring contains the marker event
+    assert any(e.get("cat") == "workload" for e in b["ring"])
+    # the open-span stack captured the span we were inside
+    assert any(s["name"] == "outer" and s["dur"] is None
+               for s in b["open_spans"])
+    # ledger + census sections present and live
+    assert b["ledger"]["live_bytes"] >= 16 * 16 * 4
+    assert b["registry_census"]["live"] >= 1
+    assert "leak_census" in b
+    d.close()
+
+
+def test_divergence_produces_one_bundle(telemetry_capture, monkeypatch):
+    tm = telemetry_capture
+    monkeypatch.setenv("DA_TPU_CHECK_DIVERGENCE", "1")
+    from distributedarrays_tpu.parallel import spmd_mode as sm
+    from distributedarrays_tpu.analysis.divergence import \
+        CollectiveDivergenceError
+    d = dat.dzeros((8, 8))                         # ledger content at crash
+
+    def f():
+        if sm.myid() == 0:
+            sm.barrier()
+
+    with pytest.raises(CollectiveDivergenceError):
+        sm.spmd(f, pids=[0, 1], timeout=30)
+    bundle = flight.last_bundle()
+    assert bundle is not None
+    assert bundle["reason"] == "exception:divergence"
+    assert bundle["exception"]["type"] == "CollectiveDivergenceError"
+    assert bundle["ledger"]["live_bytes"] >= 8 * 8 * 4
+    assert bundle["registry_census"]["live"] >= 1
+    assert bundle["divergence"], "divergence events missing from bundle"
+    # exactly ONE bundle for this crash: the divergence checker bundled
+    # it and the spmd driver's hook deduped on the exception object
+    jdir = os.path.dirname(tm.journal_path())
+    bundles = [f for f in os.listdir(jdir) if f.startswith("postmortem-")]
+    assert len(bundles) == 1, bundles
+    d.close()
+
+
+def test_djit_crash_records_bundle(telemetry_capture):
+    bad = dat.djit(lambda x: jnp.dot(x, jnp.ones((3, 3), np.float32)))
+    d = dat.dzeros((4, 4))
+    with pytest.raises(Exception):
+        bad(d)
+    b = flight.last_bundle()
+    assert b is not None and b["reason"] == "exception:djit"
+    d.close()
+
+
+def test_spmd_failure_records_bundle(telemetry_capture):
+    from distributedarrays_tpu.parallel import spmd_mode as sm
+
+    def f():
+        if sm.myid() == 1:
+            raise ValueError("rank 1 exploded")
+
+    with pytest.raises(RuntimeError, match="rank 1"):
+        sm.spmd(f, pids=[0, 1], timeout=30)
+    b = flight.last_bundle()
+    assert b is not None and b["reason"] == "exception:spmd"
+    assert b["exception"]["type"] == "ValueError"
+
+
+def test_sigusr1_dumps_bundle(telemetry_capture):
+    assert flight.install_sigusr1()
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.monotonic() + 5
+    while flight.last_bundle() is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    b = flight.last_bundle()
+    assert b is not None and b["reason"] == "sigusr1"
+
+
+def test_flight_disabled_is_noop(telemetry_capture, tmp_path):
+    tm = telemetry_capture
+    tm.disable()
+    try:
+        assert telemetry.postmortem() is None
+        assert flight.record_crash(ValueError("x"), where="test") is None
+        assert flight.last_bundle() is None
+    finally:
+        tm.enable()
+
+
+def test_bundle_cap_limits_writes(telemetry_capture, monkeypatch):
+    monkeypatch.setenv("DA_TPU_FLIGHT_MAX", "2")
+    p1 = flight.record_crash(ValueError("a"), where="t")
+    p2 = flight.record_crash(ValueError("b"), where="t")
+    p3 = flight.record_crash(ValueError("c"), where="t")
+    assert p1 is not None and p2 is not None and p3 is None
+    # same exception object never bundled twice
+    e = ValueError("dup")
+    monkeypatch.setenv("DA_TPU_FLIGHT_MAX", "10")
+    assert flight.record_crash(e, where="t") is not None
+    assert flight.record_crash(e, where="t") is None
+
+
+def test_bundle_cap_holds_in_memory_only_mode(telemetry_capture,
+                                              monkeypatch):
+    tm = telemetry_capture
+    tm.configure(None)                 # no journal, no flight dir:
+    monkeypatch.delenv("DA_TPU_FLIGHT_DIR", raising=False)
+    monkeypatch.setenv("DA_TPU_FLIGHT_MAX", "2")
+    flight.record_crash(ValueError("first"), where="t")
+    flight.record_crash(ValueError("second"), where="t")
+    flight.record_crash(ValueError("third"), where="t")
+    b = flight.last_bundle()
+    # the cap bounds bundle ASSEMBLY, not just file writes: the third
+    # crash must not have built a bundle at all
+    assert b is not None and b["exception"]["message"] == "second"
+
+
+# ---------------------------------------------------------------------------
+# exports: Prometheus gauges + Perfetto counter track
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_exports_hbm_gauges(telemetry_capture):
+    d = dat.dzeros((64, 64))
+    text = telemetry.to_prometheus()
+    assert 'da_tpu_hbm_live_bytes{device="all"} 16384' in text
+    assert 'da_tpu_hbm_live_bytes{device="0"} 2048' in text
+    assert "da_tpu_hbm_peak_bytes" in text
+    assert "da_tpu_hbm_tracked_arrays 1" in text
+    d.close()
+
+
+def test_perfetto_hbm_counter_track(telemetry_capture):
+    tm = telemetry_capture
+    d = dat.dzeros((32, 32))
+    d.close()
+    trace = telemetry.to_perfetto(read_journal(tm.journal_path()))
+    counters = [e for e in trace["traceEvents"]
+                if e["ph"] == "C" and e["name"] == "hbm_bytes"]
+    assert counters, "no HBM counter track in the Perfetto export"
+    assert any(c["args"].get("live", 0) >= 32 * 32 * 4 for c in counters)
+    for c in counters:                             # strict-viewer keys
+        for key in ("ph", "ts", "dur", "pid", "tid"):
+            assert key in c
+
+
+# ---------------------------------------------------------------------------
+# CLI: mem / postmortem subcommands, rc-2 journal guards
+# ---------------------------------------------------------------------------
+
+
+def _cli(argv):
+    from distributedarrays_tpu.telemetry.__main__ import main
+    return main(argv)
+
+
+def test_cli_mem_from_journal_and_report(telemetry_capture, tmp_path,
+                                         capsys):
+    tm = telemetry_capture
+    d = dat.dzeros((64, 64))
+    rc = _cli(["mem", tm.journal_path()])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "hbm peak:" in out and "16.0 KiB" in out
+    assert "top allocation sites:" in out
+    # report input carries the per-device table
+    report_path = str(tmp_path / "report.json")
+    tm.dump(report_path)
+    rc = _cli(["mem", report_path])
+    out = capsys.readouterr().out
+    assert rc == 0 and "per device:" in out
+    rc = _cli(["mem", tm.journal_path(), "--json"])
+    mem = json.loads(capsys.readouterr().out)
+    assert rc == 0 and mem["peak_bytes"] >= 16384
+    d.close()
+
+
+def test_cli_postmortem_renders_bundle(telemetry_capture, capsys):
+    d = dat.dzeros((16, 16))
+    path = telemetry.postmortem()
+    d.close()
+    rc = _cli(["postmortem", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "postmortem: on_demand" in out
+    assert "registry census:" in out and "event ring tail" in out
+    rc = _cli(["postmortem", path, "--json"])
+    b = json.loads(capsys.readouterr().out)
+    assert rc == 0 and b["kind"] == "da_tpu_postmortem"
+
+
+def test_cli_rc2_on_missing_empty_capped(telemetry_capture, tmp_path,
+                                         capsys, monkeypatch):
+    tm = telemetry_capture
+    # missing
+    rc = _cli(["summarize", str(tmp_path / "nope.jsonl")])
+    assert rc == 2
+    assert "cannot read input" in capsys.readouterr().err
+    # empty
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    for sub in ("summarize", "trace"):
+        rc = _cli([sub, str(empty)])
+        assert rc == 2, sub
+        assert "journal is empty" in capsys.readouterr().err
+    rc = _cli([str(empty)])                        # legacy bare form
+    assert rc == 2
+    capsys.readouterr()
+    # cap-truncated: the journal.capped latch is printed, rc 2
+    monkeypatch.setenv("DA_TPU_TELEMETRY_JOURNAL_MAX_MB", "0.001")
+    capped = tmp_path / "capped.jsonl"
+    tm.configure(str(capped))
+    for i in range(200):
+        tm.event("filler", "e", i=i, payload="x" * 64)
+    rc = _cli(["summarize", str(capped)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "cap-truncated" in err and "journal.capped" in err
+    # prom and mem must refuse the truncated journal too — a dashboard
+    # (or ledger view) fed under-counted totals is worse than none
+    for sub in ("prom", "mem"):
+        rc = _cli([sub, str(capped)])
+        err = capsys.readouterr().err
+        assert rc == 2 and "cap-truncated" in err, sub
+
+
+# ---------------------------------------------------------------------------
+# satellite: fixture helpers
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_assert_counter_and_mem(telemetry_capture):
+    tm = telemetry_capture
+    tm.count("my.counter", 3, kind="x")
+    assert tm.assert_counter("my.counter", 3, kind="x") == 3
+    with pytest.raises(AssertionError, match="recorded counters"):
+        tm.assert_counter("my.counter", 4, kind="x")
+    with pytest.raises(AssertionError):
+        tm.assert_counter("never.recorded")
+    d = dat.dzeros((16, 16))
+    m = tm.mem()
+    assert m["live_bytes"] >= 16 * 16 * 4 and m["tracked_arrays"] >= 1
+    d.close()
+
+
+def test_disabled_mode_ledger_is_single_check(telemetry_capture):
+    tm = telemetry_capture
+    tm.disable()
+    try:
+        d = dat.dzeros((32, 32))                   # not tracked
+        assert tmem.live_bytes() == 0
+        assert tmem.tracked_count() == 0
+        with tmem.staging("x", 1 << 20):
+            assert tmem.staging_peak() == 0
+        d.close()                                  # untrack no-ops cleanly
+        assert tmem.live_bytes() == 0
+    finally:
+        tm.enable()
+
+
+def test_disable_midway_still_drains(telemetry_capture):
+    tm = telemetry_capture
+    d = dat.dzeros((32, 32))
+    assert tmem.live_bytes() > 0
+    tm.disable()
+    try:
+        d.close()                                  # tracked while enabled:
+        assert tmem.live_bytes() == 0              # close must still drain
+    finally:
+        tm.enable()

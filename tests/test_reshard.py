@@ -169,7 +169,7 @@ def test_plan_cache_hits_via_telemetry(telemetry_capture, rng):
     build0 = tm.counter_value("reshard.plan_builds")
     for _ in range(5):
         R.plan_reshard(x, dst)
-    assert tm.counter_value("reshard.plan_requests") - req0 == 5
+    assert tm.assert_counter("reshard.plan_requests", req0 + 5) == req0 + 5
     # repeated same-layout-pair planning hits the lru — zero new builds
     assert tm.counter_value("reshard.plan_builds") - build0 == 0
 

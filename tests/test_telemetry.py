@@ -180,12 +180,15 @@ def test_warn_once_site_counts_exactly_once_per_trigger(telemetry_capture,
     from distributedarrays_tpu.utils.debug import warn_once
     tm = telemetry_capture
     warn_once("telemetrytest-site", "degraded")
-    assert tm.counter_value("fallback.hits", key="telemetrytest-site") == 1
+    # assert_counter returns the observed value, so exactness is kept
+    assert tm.assert_counter("fallback.hits",
+                             key="telemetrytest-site") == 1
     assert len(tm.events("fallback")) == 1
     # a second hit of the same site: counted (hits are per-occurrence),
     # journaled and warned only once
     warn_once("telemetrytest-site", "degraded")
-    assert tm.counter_value("fallback.hits", key="telemetrytest-site") == 2
+    assert tm.assert_counter("fallback.hits", 2,
+                             key="telemetrytest-site") == 2
     assert len(tm.events("fallback")) == 1
 
 
@@ -287,8 +290,8 @@ def test_checkpoint_phase_events(telemetry_capture, tmp_path):
                      "restore_end"]
     end = tm.events("checkpoint")[1]
     assert end["bytes"] == 64 and end["arrays"] == 1
-    assert tm.counter_value("checkpoint.saves") == 1
-    assert tm.counter_value("checkpoint.restores") == 1
+    assert tm.assert_counter("checkpoint.saves") == 1
+    assert tm.assert_counter("checkpoint.restores") == 1
 
 
 def test_collectives_rec_is_counted_and_flagged_traced(telemetry_capture):
@@ -656,6 +659,8 @@ with tempfile.TemporaryDirectory() as td:
 import json
 r = telemetry.report()
 print("REPORT " + json.dumps(r))
+pm = telemetry.postmortem()
+print("PM " + json.dumps(pm is not None))
 """
 
 
@@ -671,15 +676,21 @@ def test_scripted_workload_acceptance(tmp_path):
     r = _run_workload({"DA_TPU_TELEMETRY": "1",
                        "DA_TPU_TELEMETRY_JOURNAL": str(jpath)})
     assert r.returncode == 0, r.stderr[-2000:]
-    rep = json.loads(r.stdout.split("REPORT ", 1)[1])
+    rep = json.loads(r.stdout.split("REPORT ", 1)[1].splitlines()[0])
     # nonzero reshard count and nonzero estimated comm bytes
     assert rep["comm"]["by_kind"]["reshard"]["ops"] >= 1
     assert rep["comm"]["total_bytes"] > 0
     # at least one journal event per instrumented category the workload
     # exercises: communication, jit builds, mesh builds, autotune lookups
     cats = rep["events"]["by_category"]
-    for cat in ("comm", "jit", "mesh", "autotune", "reshard"):
+    for cat in ("comm", "jit", "mesh", "autotune", "reshard", "hbm"):
         assert cats.get(cat, 0) >= 1, (cat, cats)
+    # HBM ledger: the workload's live arrays are tracked, watermark moved
+    assert rep["memory"]["live_bytes"] > 0
+    assert rep["memory"]["peak_bytes"] >= rep["memory"]["live_bytes"]
+    assert rep["memory"]["tracked_arrays"] >= 4
+    # on-demand postmortem wrote a bundle (journal dir is configured)
+    assert "PM true" in r.stdout
     # the journal file round-trips through the summarizer
     s = summarize(read_journal(str(jpath)))
     assert s["comm"]["by_kind"]["reshard"]["ops"] >= 1
@@ -721,12 +732,20 @@ def test_scripted_workload_disabled_is_silent(tmp_path):
     r = _run_workload({"DA_TPU_TELEMETRY": "0",
                        "DA_TPU_TELEMETRY_JOURNAL": str(jpath)})
     assert r.returncode == 0, r.stderr[-2000:]
-    rep = json.loads(r.stdout.split("REPORT ", 1)[1])
+    rep = json.loads(r.stdout.split("REPORT ", 1)[1].splitlines()[0])
     assert rep["enabled"] is False
     assert rep["counters"] == {}
     assert rep["comm"]["total_bytes"] == 0 and rep["comm"]["total_ops"] == 0
     assert rep["events"]["recorded"] == 0
     # spans collapse to the same single boolean check: none recorded
     assert rep["spans"]["finished"] == 0 and rep["spans"]["by_name"] == {}
+    # the HBM ledger's hooks collapse to the same single boolean check:
+    # nothing tracked, no watermark, no staging
+    assert rep["memory"]["live_bytes"] == 0
+    assert rep["memory"]["peak_bytes"] == 0
+    assert rep["memory"]["tracked_arrays"] == 0
+    assert rep["memory"]["staging"]["peak_bytes"] == 0
+    # and the flight recorder refuses to bundle
+    assert "PM false" in r.stdout
     assert not jpath.exists(), \
         "DA_TPU_TELEMETRY=0 must not create a journal file"

@@ -47,6 +47,9 @@ print('probe-ok', d[0].platform, float((x@x)[0,0]))
       if [ -s "$BENCH_JOURNAL" ]; then
         timeout 120 python -m distributedarrays_tpu.telemetry summarize \
             "$BENCH_JOURNAL" >> "$LOG" 2>&1
+        echo "=== HBM ledger (telemetry mem) ===" >> "$LOG"
+        timeout 120 python -m distributedarrays_tpu.telemetry mem \
+            "$BENCH_JOURNAL" >> "$LOG" 2>&1
       else
         echo "(no telemetry journal produced)" >> "$LOG"
       fi
