@@ -35,6 +35,7 @@ methodology bug, not a fast chip).
 
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -153,11 +154,36 @@ _PROBE_CODE = (_FORCE +
                "[str(d) for d in jax.devices()])")
 
 
+def _backoff_sleep(attempt: int, base: float = 12.0, cap: float = 60.0,
+                   bound: float | None = None):
+    """Jittered exponential backoff between probe attempts.  Jitter
+    matters here for the same reason it does in any retry storm: the
+    watch loop, the driver's full run, and a targeted rerun can all be
+    probing the same wedged tunnel, and synchronized retries hammer it
+    at the same instants.  Deterministic under DA_TPU_FAULT_SEED (the
+    chaos harness's seed) so resilience tests replay exactly.
+    ``bound`` caps the sleep (remaining-budget clamp)."""
+    delay = min(base * (2 ** attempt), cap)
+    try:
+        seed = int(os.environ.get("DA_TPU_FAULT_SEED", ""))
+    except ValueError:
+        seed = None          # unset/garbage seed: genuinely random jitter
+    # integer seed mixing, not tuple hashing (hash salting breaks replay)
+    r = (random.Random(seed * 1_000_003 + attempt).random()
+         if seed is not None else random.random())
+    s = delay * (0.5 + r)
+    if bound is not None:
+        s = min(s, max(bound, 0.0))
+    time.sleep(s)
+
+
 def _probe_with_retry(budget_s: float = 900.0):
     """Probe the accelerator in FRESH SUBPROCESSES with growing timeouts
-    and backoff: the observed wedges are transient (VERDICT round-3 item
-    1), and a wedged attempt must not poison this process's runtime.
-    Returns {"ok": True, "attempts": n} or {"ok": False, "error": ...}."""
+    and bounded, jitter-backoff retries: the observed wedges are
+    transient (VERDICT round-3 item 1, the BENCH_r01–r05 "unreachable"
+    failure mode), and a wedged attempt must not poison this process's
+    runtime.  Returns {"ok": True, "attempts": n} or
+    {"ok": False, "error": ...}."""
     t0 = time.monotonic()
     schedule = [90, 120, 180, 240, 300, 300, 300]
     errors = []
@@ -177,7 +203,11 @@ def _probe_with_retry(budget_s: float = 900.0):
                           f"{(r.stderr or r.stdout)[-200:]!r}")
         except subprocess.TimeoutExpired:
             errors.append(f"attempt {i+1}: timed out after {tmo:.0f}s")
-        time.sleep(25)
+        # no dead sleep after the FINAL attempt, and never sleep past
+        # the budget: the failure path must report promptly
+        left = budget_s - (time.monotonic() - t0)
+        if i < len(schedule) - 1 and left > 45:
+            _backoff_sleep(i, bound=left - 45)
     return {"ok": False,
             "error": f"accelerator unreachable after {len(errors)} attempts "
                      f"over {time.monotonic() - t0:.0f}s: "
@@ -223,18 +253,21 @@ def _collapse_provenances(prior_provs):
     makes ~21 invocations against the same chip, and 21 near-identical
     dicts in a tracked file record nothing the utc list doesn't.
     Headers from a DIFFERENT device/platform/method stay separate — that
-    distinction is the point of the chain.  ``probe_attempts`` is
-    evidence (how flaky was the tunnel for these measurements) — the max
-    is carried through as ``probe_attempts_max`` instead of being
-    dropped with the per-run header (ADVICE round-5)."""
+    distinction is the point of the chain.  ``probe_attempts`` /
+    ``device_init_attempts`` are evidence (how flaky was the tunnel for
+    these measurements) — the max is carried through as
+    ``probe_attempts_max`` instead of being dropped with the per-run
+    header (ADVICE round-5)."""
     collapsed = []
     for p in prior_provs:
         sig = {k: v for k, v in p.items()
                if k not in ("utc", "utcs", "probe_attempts",
-                            "probe_attempts_max")}
+                            "device_init_attempts", "probe_attempts_max")}
         utcs = p.get("utcs", []) + ([p["utc"]] if p.get("utc") else [])
         atts = [a for a in (p.get("probe_attempts_max"),
-                            p.get("probe_attempts")) if a is not None]
+                            p.get("probe_attempts"),
+                            p.get("device_init_attempts"))
+                if a is not None]
         for c in collapsed:
             if {k: v for k, v in c.items()
                     if k not in ("utcs", "probe_attempts_max")} == sig:
@@ -521,18 +554,22 @@ def main():
         shutil.copyfile(cur, cur.with_name("BENCH_DETAILS_prev.json"))
 
     # device init in THIS process can still wedge even after a subprocess
-    # probe succeeded — bounded, with one retry
-    for attempt in range(2):
+    # probe succeeded — bounded retries with the same jittered backoff as
+    # the subprocess probe, attempts banked as provenance evidence
+    init_attempts = 0
+    for attempt in range(3):
+        init_attempts = attempt + 1
         finished, devs, _ = _run_with_timeout(jax.devices, 300)
         if finished and not isinstance(devs, Exception):
             break
-        time.sleep(20)
+        if attempt < 2:           # no dead sleep after the final attempt
+            _backoff_sleep(attempt, base=15.0)
     else:
         print(json.dumps({
             "metric": _HEADLINE_METRIC,
             "value": 0.0, "unit": "GFLOPS", "vs_baseline": 0.0,
-            "error": "probe subprocess succeeded but in-process device "
-                     "init wedged twice",
+            "error": f"probe subprocess succeeded but in-process device "
+                     f"init wedged {init_attempts} times",
         }))
         return
 
@@ -549,6 +586,7 @@ def main():
                       "scalar-fetch forced; marginal t(L+1)-t(1) recorded "
                       "as *_marginal_crosscheck_s diagnostics only",
             "probe_attempts": probe.get("attempts"),
+            "device_init_attempts": init_attempts,
         },
     }
 

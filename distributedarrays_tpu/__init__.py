@@ -13,7 +13,7 @@ See SURVEY.md at the repo root for the layer-by-layer mapping.
 """
 
 from .core import (allowscalar, close, d_closeall, next_did, procs, registry,
-                   live_ids, current_rank)
+                   live_ids, live_arrays, current_rank)
 from .darray import (DArray, SubDArray, SubOrDArray, DData, darray,
                      darray_like, dfromfunction, from_chunks, dzeros, dones, dfill, drand,
                      drandint, dsample, drandn, distribute, ddata, gather, localpart,
@@ -35,6 +35,7 @@ from .ops.linalg import (axpy_, ddot, dnorm, rmul_, lmul_, lmul_diag,
 from .ops.sort import dsort
 from .ops.sparse import dnnz, ddata_bcoo
 from . import parallel
+from . import resilience
 from . import telemetry
 
 __version__ = "0.1.0"

@@ -24,7 +24,8 @@ import weakref
 
 from . import telemetry as _tm
 
-__all__ = ["next_did", "d_closeall", "close", "registry", "live_ids", "procs"]
+__all__ = ["next_did", "d_closeall", "close", "registry", "live_ids",
+           "live_arrays", "procs"]
 
 _id_counter = itertools.count(1)
 _id_lock = threading.Lock()
@@ -74,6 +75,14 @@ def registry() -> dict:
 
 def live_ids() -> list[tuple[int, int]]:
     return sorted(registry().keys())
+
+
+def live_arrays() -> list:
+    """Strong references to every live registered DArray, id-ordered —
+    the iteration surface the elastic device-set manager re-lays-out
+    over (a weakref snapshot would let arrays die mid-re-layout)."""
+    snap = registry()
+    return [d for d in (snap[k]() for k in sorted(snap)) if d is not None]
 
 
 def close(d) -> None:

@@ -60,6 +60,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import layout as L
 from .. import telemetry as _tm
+from ..resilience import faults as _fl
 from .collectives import pall_to_all, pgather, shard_map_compat
 
 __all__ = ["ReshardPlan", "plan_reshard", "reshard", "plan_stats",
@@ -470,6 +471,10 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
         return x
     with _tm.span("reshard", op=op, strategy=plan.strategy):
         if plan.collective:
+            # chaos site: an armed fault plan can abort the planned
+            # collective here — mid-reshard, before any chunk moves, so
+            # the source buffer is still intact for the retry
+            _fl.check("reshard.chunk", strategy=plan.strategy, op=op)
             try:
                 # staging high-water: one chunk piece of the local shard
                 # is what the chunked lowering stages per device.  This

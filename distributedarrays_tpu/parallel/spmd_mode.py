@@ -28,6 +28,7 @@ SPMD closures address their chunk.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Sequence
@@ -38,6 +39,7 @@ from .. import core
 from .. import layout as L
 from .. import telemetry as _tm
 from ..analysis import divergence as _dv
+from ..resilience import faults as _fl
 
 __all__ = [
     "spmd", "sendto", "recvfrom", "recvfrom_any", "barrier", "bcast",
@@ -45,7 +47,20 @@ __all__ = [
     "nprocs", "SPMDContext", "close_context",
 ]
 
+_TIMEOUT_ENV = "DA_TPU_SPMD_TIMEOUT"
 _DEFAULT_TIMEOUT = 60.0  # seconds; a stuck collective fails loudly, not forever
+
+
+def _default_timeout() -> float:
+    """The receive-timeout default: ``DA_TPU_SPMD_TIMEOUT`` seconds when
+    set (resilience tests shrink it to trip fast; pod jobs with slow DCN
+    raise it), else 60s.  Read per call so a test can flip the env
+    without reimporting; both the thread and process backends resolve
+    their ``timeout=None`` defaults through here."""
+    try:
+        return float(os.environ.get(_TIMEOUT_ENV, _DEFAULT_TIMEOUT))
+    except ValueError:
+        return _DEFAULT_TIMEOUT
 
 
 _PEER_ABORT = "SPMD peer task failed; aborting receive"
@@ -73,9 +88,31 @@ def _scan_stash(msgs: list, match: Callable[[tuple], bool]):
     return None
 
 
-def _receive_timeout(timeout: float, msgs: list) -> TimeoutError:
+def _timeout_source(timeout: float) -> str:
+    """Where the effective receive timeout came from — named honestly:
+    the env var is credited only when it actually produced this value
+    (an explicit ``timeout=`` argument overrides it, and an unparsable
+    value silently falls back to the default)."""
+    configured = os.environ.get(_TIMEOUT_ENV)
+    if configured is not None:
+        try:
+            if float(configured) == timeout:
+                return f"{_TIMEOUT_ENV}={configured}"
+        except ValueError:
+            if timeout == _DEFAULT_TIMEOUT:
+                return (f"{_TIMEOUT_ENV}={configured!r} invalid, using "
+                        f"default {_DEFAULT_TIMEOUT:g}s")
+        return "explicit timeout argument"
+    if timeout == _DEFAULT_TIMEOUT:
+        return f"default {_DEFAULT_TIMEOUT:g}s; set {_TIMEOUT_ENV}"
+    return "explicit timeout argument"
+
+
+def _receive_timeout(timeout: float, msgs: list,
+                     tag: Any = None) -> TimeoutError:
     return TimeoutError(
         f"SPMD receive timed out after {timeout}s "
+        f"({_timeout_source(timeout)}) blocked on tag={tag!r} "
         f"(pending: {[(m[0], m[1], m[3]) for m in msgs[:8]]})")
 
 
@@ -94,7 +131,7 @@ class _Mailbox:
             self._cond.notify_all()
 
     def take(self, match: Callable[[tuple], bool], failed: "threading.Event",
-             timeout: float):
+             timeout: float, tag: Any = None):
         # span: the drain wait is where SPMD programs spend their blocked
         # time — aggregate-only (_journal=False: a chatty ring would emit
         # thousands of journal lines), visible in span_stats()/report()
@@ -109,7 +146,7 @@ class _Mailbox:
                         raise RuntimeError(_PEER_ABORT)
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        raise _receive_timeout(timeout, self._msgs)
+                        raise _receive_timeout(timeout, self._msgs, tag)
                     self._cond.wait(min(remaining, 0.1))
 
 
@@ -227,24 +264,30 @@ def sendto(pid: int, data: Any, tag: Any = None):
     ctx.mailbox(pid).put(("sendto", rank, data, tag))
 
 
-def recvfrom(pid: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+def recvfrom(pid: int, tag: Any = None, timeout: float | None = None):
     """Blocking receive of a message from ``pid`` with matching ``tag``
     (reference recvfrom, spmd.jl:149-151).  Out-of-order messages stay
-    buffered until their matching receive."""
+    buffered until their matching receive.  ``timeout`` defaults to
+    ``DA_TPU_SPMD_TIMEOUT`` (60s unset)."""
     ctx, rank = _current()
+    if timeout is None:
+        timeout = _default_timeout()
     m = ctx.mailbox(rank).take(
         lambda m: m[0] == "sendto" and m[1] == pid and m[3] == tag,
-        ctx._failed, timeout)
+        ctx._failed, timeout, tag=tag)
     _tm.count("spmd.recv")
     return m[2]
 
 
-def recvfrom_any(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+def recvfrom_any(tag: Any = None, timeout: float | None = None):
     """Receive from whichever rank sends first; returns ``(from_pid, data)``
     (reference recvfrom_any, spmd.jl:153-157)."""
     ctx, rank = _current()
+    if timeout is None:
+        timeout = _default_timeout()
     m = ctx.mailbox(rank).take(
-        lambda m: m[0] == "sendto" and m[3] == tag, ctx._failed, timeout)
+        lambda m: m[0] == "sendto" and m[3] == tag, ctx._failed, timeout,
+        tag=tag)
     _tm.count("spmd.recv")
     return m[1], m[2]
 
@@ -265,12 +308,15 @@ def _dv_note(ctx, rank: int, op: str, detail: str) -> None:
         ck.record(rank, op, detail)
 
 
-def barrier(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+def barrier(tag: Any = None, timeout: float | None = None):
     """All-to-all barrier with double-barrier protection via per-rank
     generation counters (reference barrier, spmd.jl:159-184)."""
     ctx, rank = _current()
+    _fl.check("spmd.collective", op="barrier", rank=rank)
     _dv_note(ctx, rank, "barrier", f"tag={tag!r}")
     _tm.count("spmd.barrier")
+    if timeout is None:
+        timeout = _default_timeout()
     gen = ctx._barrier_gen[rank]
     ctx._barrier_gen[rank] = gen + 1
     btag = ("barrier", gen, tag)
@@ -279,7 +325,7 @@ def barrier(tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
     for p in ctx.pids:
         ctx.mailbox(rank).take(
             lambda m, p=p: m[0] == "barrier" and m[1] == p and m[3] == btag,
-            ctx._failed, timeout)
+            ctx._failed, timeout, tag=btag)
 
 
 def _check_root(ctx, root):
@@ -288,11 +334,14 @@ def _check_root(ctx, root):
 
 
 def bcast(data: Any, root: int, tag: Any = None,
-          timeout: float = _DEFAULT_TIMEOUT):
+          timeout: float | None = None):
     """Broadcast from ``root`` to every rank (reference bcast,
     spmd.jl:186-196)."""
     ctx, rank = _current()
     _check_root(ctx, root)
+    _fl.check("spmd.collective", op="bcast", rank=rank)
+    if timeout is None:
+        timeout = _default_timeout()
     # payload signature excluded: only root's data participates (non-root
     # ranks conventionally pass None), so shapes legitimately differ
     _dv_note(ctx, rank, "bcast", f"root={root}, tag={tag!r}")
@@ -309,16 +358,19 @@ def bcast(data: Any, root: int, tag: Any = None,
         return data
     m = ctx.mailbox(rank).take(
         lambda m: m[0] == "sendto" and m[1] == root and m[3] == btag,
-        ctx._failed, timeout)
+        ctx._failed, timeout, tag=btag)
     return m[2]
 
 
-def scatter(x, root: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
+def scatter(x, root: int, tag: Any = None, timeout: float | None = None):
     """Split ``x`` evenly across ranks from ``root`` (reference scatter,
     spmd.jl:198-212; equal division is asserted like the reference's
     ``@assert rem(length(x), length(pids)) == 0``)."""
     ctx, rank = _current()
     _check_root(ctx, root)
+    _fl.check("spmd.collective", op="scatter", rank=rank)
+    if timeout is None:
+        timeout = _default_timeout()
     _dv_note(ctx, rank, "scatter", f"root={root}, tag={tag!r}")
     stag = ("scatter", tag)
     if rank == root:
@@ -340,16 +392,19 @@ def scatter(x, root: int, tag: Any = None, timeout: float = _DEFAULT_TIMEOUT):
         return mine
     m = ctx.mailbox(rank).take(
         lambda m: m[0] == "sendto" and m[1] == root and m[3] == stag,
-        ctx._failed, timeout)
+        ctx._failed, timeout, tag=stag)
     return m[2]
 
 
 def gather_spmd(x, root: int, tag: Any = None,
-                timeout: float = _DEFAULT_TIMEOUT):
+                timeout: float | None = None):
     """Collect one value per rank at ``root``, pid-ordered (reference gather,
     spmd.jl:214-231).  Returns the list on root, None elsewhere."""
     ctx, rank = _current()
     _check_root(ctx, root)
+    _fl.check("spmd.collective", op="gather_spmd", rank=rank)
+    if timeout is None:
+        timeout = _default_timeout()
     _dv_note(ctx, rank, "gather_spmd",
              f"root={root}, tag={tag!r}, "
              f"payload={_dv.payload_signature(x)}")
@@ -368,7 +423,7 @@ def gather_spmd(x, root: int, tag: Any = None,
             continue
         m = ctx.mailbox(rank).take(
             lambda m, p=p: m[0] == "sendto" and m[1] == p and m[3] == gtag,
-            ctx._failed, timeout)
+            ctx._failed, timeout, tag=gtag)
         out[p] = m[2]
     return [out[p] for p in ctx.pids]
 
@@ -440,6 +495,9 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
         core._rank_tls.rank = rank
         _tls.ctxt = ctx
         try:
+            # deterministic chaos: an armed fault plan can kill/hang this
+            # rank at task start — the thread-backend "host death" site
+            _fl.check("spmd.rank", rank=rank, backend="thread")
             # per-rank step span: a fresh thread has no contextvar parent,
             # so rank timelines are independent root spans (one Perfetto
             # track per rank thread)

@@ -55,7 +55,8 @@ class _QueueMailbox:
     def put(self, msg: tuple):
         self._q.put(msg)
 
-    def take(self, match: Callable[[tuple], bool], failed, timeout: float):
+    def take(self, match: Callable[[tuple], bool], failed, timeout: float,
+             tag=None):
         import queue as queue_mod
         from .spmd_mode import _PEER_ABORT, _receive_timeout, _scan_stash
         deadline = time.monotonic() + timeout
@@ -67,7 +68,7 @@ class _QueueMailbox:
                 raise RuntimeError(_PEER_ABORT)
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise _receive_timeout(timeout, self._stash)
+                raise _receive_timeout(timeout, self._stash, tag)
             try:
                 self._stash.append(self._q.get(timeout=min(remaining, 0.1)))
             except queue_mod.Empty:
@@ -136,15 +137,26 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
     failed = mpctx.Event()
 
     from .. import core
+    from ..resilience import faults as _fl
     from . import spmd_mode
+
+    # fault decisions happen HERE, parent-side, so the plan's per-spec
+    # invocation counters persist across retries (a counter bumped inside
+    # a forked child dies with it); only the ACTION runs in the child —
+    # a raise ships home as a rank failure, an "exit" dies unreported
+    dooms = {p: _fl.decide("spmd.rank", rank=p, backend="process")
+             for p in ctx.pids} if _fl.active() else {}
 
     def child(rank: int):
         rctx = _RunContext(ctx.id, ctx.pids, queues, ctx.store, failed,
                            list(leftover[rank]))
         core._rank_tls.rank = rank
         spmd_mode._tls.ctxt = rctx
+        os.environ["DA_TPU_FAULT_CHILD"] = "1"   # arms the "exit" action
         try:
             try:
+                _fl.act(dooms.get(rank),
+                        {"rank": rank, "backend": "process"})
                 r = f(*args)
                 status = (rank, "ok", r, rctx.store.get(rank, {}))
             except BaseException as e:  # noqa: BLE001 — shipped to parent

@@ -1,0 +1,35 @@
+"""Fault tolerance: deterministic fault injection, elastic device sets,
+and a retrying executor with postmortem-driven verdicts.
+
+ROADMAP open item 5 ("Elastic device sets and fault-tolerant execution")
+in three layers:
+
+- :mod:`.faults` — the seeded chaos harness (``DA_TPU_FAULT_SEED`` /
+  ``DA_TPU_FAULT_PLAN``): kill/hang/revive a simulated host or device at
+  instrumented points (spmd rank start, collectives, reshard, checkpoint
+  write), deterministically.
+- :mod:`.elastic` — device-health ledger + in-place DArray re-layout
+  onto survivors (shrink) and back (grow), through the reshard planner,
+  with the HBM ledger and lifecycle registry updated as it goes.
+- :mod:`.recovery` — bounded retry + backoff + jitter around any
+  workload, where the flight recorder's bundle classifies each failure
+  (divergence → never retried; device loss → restore-from-checkpoint,
+  shrink, retry; timeout → one fresh-mesh retry).
+
+See ``docs/resilience.md`` for the fault-plan format, the recovery
+decision table, and a worked chaos walkthrough.
+"""
+
+from . import elastic, faults, recovery  # noqa: F401
+from .elastic import ElasticDeviceSet, manager, relayout
+from .faults import (FaultSpec, InjectedDeviceLoss, InjectedFault)
+from .recovery import RetryPolicy, classify, fresh_mesh, resilient, \
+    run_with_recovery
+
+__all__ = [
+    "faults", "elastic", "recovery",
+    "FaultSpec", "InjectedFault", "InjectedDeviceLoss",
+    "ElasticDeviceSet", "manager", "relayout",
+    "RetryPolicy", "classify", "fresh_mesh", "resilient",
+    "run_with_recovery",
+]

@@ -1,0 +1,281 @@
+"""Elastic device sets: shrink onto survivors, grow back on revival.
+
+The reference's process pool is genuinely elastic — ``addprocs`` /
+``rmprocs`` change the worker set mid-session and DArrays are rebuilt on
+whatever workers exist.  This module is the TPU-native counterpart for
+the single-controller world: a health ledger over the device ranks, and a
+re-layout engine that moves every *registered* DArray (the lifecycle
+registry is the source of truth — ``core.live_arrays()``) onto the
+current live set through the PR 4 reshard planner.
+
+Semantics:
+
+- :func:`manager` — the process-wide :class:`ElasticDeviceSet`.
+- ``mark_down`` / ``mark_up`` — explicit health edits (a real deployment
+  wires these to its platform's health signal).
+- ``probe()`` — one health epoch: merges the manual marks with the fault
+  harness's simulated-down set (``faults.probe_tick`` — which is also
+  where simulated devices revive), updates the ``elastic.live_devices``
+  gauge, and journals transitions.
+- ``shrink()`` — re-lay-out every registered DArray that touches a down
+  rank onto the survivors.  Data movement is ``parallel.reshard`` with a
+  device-set-changing plan (the planner's ``device_put`` fallback — the
+  correct strategy: survivors must receive bytes they never held).  The
+  DArray mutates **in place**: same id, same registry entry, new
+  pids/indices/cuts/sharding/buffer — and the HBM ledger re-tracks the
+  buffer under the same owner, so per-device gauges show the downed
+  rank's bytes draining to zero.
+- ``grow()`` — the inverse after revival: re-lay-out the arrays
+  ``shrink()`` displaced (and ONLY those — a deliberate non-default
+  layout the failure never touched is not the manager's to change)
+  onto the recovered live set.
+
+A *simulated* downed device still physically answers reads, so
+``shrink`` is data-preserving here; after a REAL device loss the read
+fails, the array is left in place, and the ``recovery`` executor's
+checkpoint restore is the data path — ``shrink`` then simply re-lays-out
+the freshly restored arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import core
+from .. import layout as L
+from .. import telemetry as _tm
+from . import faults
+
+__all__ = ["ElasticDeviceSet", "manager", "relayout"]
+
+
+def relayout(d, ranks: list[int]) -> bool:
+    """Re-lay-out DArray ``d`` onto ``ranks`` (default layout) in place.
+
+    Returns True when the array moved, False when it already has the
+    target layout.  The move routes through ``parallel.reshard`` (plan
+    cache + telemetry attribution); the registry entry and array id are
+    unchanged, and the HBM ledger re-tracks the new buffer under the
+    same owner id.
+    """
+    # direct from-imports: the package re-exports a `darray` FUNCTION
+    # that shadows the module attribute of the same name
+    from ..darray import _blocked_pad_jit, _cuts_key
+    from ..parallel import reshard as _rs
+
+    ranks = [int(r) for r in ranks]
+    if not ranks:
+        raise ValueError("cannot re-lay-out onto an empty device set")
+    dims = tuple(d.dims)
+    dist = L.defaultdist(dims, ranks)
+    grid = tuple(int(c) for c in dist)
+    need = int(np.prod(grid)) if grid else 1
+    use = ranks[:need]
+    idxs, cuts = L.chunk_idxs(dims, grid)
+    if list(use) == [int(p) for p in d.pids.flat] and \
+            [list(c) for c in cuts] == [list(c) for c in d.cuts]:
+        return False
+    with d._mutlock:
+        d._check_open()
+        sharding = L.sharding_for(use, grid, dims)
+        with _tm.span("elastic.relayout", id=str(d.id)):
+            # build the FULL replacement buffer before touching any
+            # metadata: a failure mid-move (the downed device really is
+            # gone) must leave the array consistent for the
+            # checkpoint-restore path, not half-re-laid-out
+            cuts_l = [list(int(x) for x in c) for c in cuts]
+            pdims = L.padded_dims(cuts)
+            padded = pdims != dims
+            logical = d.garray            # padded layouts reassemble here
+            new_data = _rs.reshard(logical, sharding, op="elastic")
+            psh = None
+            if padded:
+                psh = L.padded_sharding_for(use, grid, pdims)
+                new_data = _blocked_pad_jit(_cuts_key(cuts_l),
+                                            psh)(new_data)
+            d._leave_share()
+            d.pids = np.asarray(use, dtype=np.int64).reshape(grid)
+            d.indices = idxs
+            d.cuts = cuts_l
+            d._bs = L.block_sizes(cuts)
+            d._padded = padded
+            d._psharding = psh
+            # `sharding` already follows the dims-divisibility rule
+            # (L.sharding_for), so it is the ops-facing logical sharding
+            # for BOTH even and padded layouts
+            d._sharding = sharding
+            d._data = new_data
+            if _tm.enabled():
+                _tm.memory.track(d.id, d._data, site="elastic")
+    _tm.count("elastic.relayouts")
+    return True
+
+
+class ElasticDeviceSet:
+    """Health ledger over the device ranks plus the re-layout engine."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._manual_down: dict[int, float] = {}    # rank -> since (mono)
+        self._sim_down: set[int] = set()
+        # array ids shrink() re-laid-out — the ONLY ids grow() touches:
+        # an array the failure never displaced keeps whatever layout its
+        # owner chose (growing everything would destroy deliberate
+        # non-default distributions)
+        self._shrunk: set = set()
+
+    # -- health ------------------------------------------------------------
+
+    def all_ranks(self) -> list[int]:
+        return L.all_ranks()
+
+    def down_ranks(self) -> set[int]:
+        with self._lock:
+            return set(self._manual_down) | set(self._sim_down)
+
+    def live_ranks(self) -> list[int]:
+        down = self.down_ranks()
+        return [r for r in L.all_ranks() if r not in down]
+
+    def mark_down(self, rank: int, reason: str = "manual") -> None:
+        with self._lock:
+            fresh = int(rank) not in self._manual_down
+            self._manual_down.setdefault(int(rank), time.monotonic())
+        if fresh:
+            _tm.count("elastic.marked_down")
+            if _tm.enabled():
+                # cold path: a device transition is an exceptional event
+                _tm.event("elastic", "down", rank=int(rank),  # dalint: disable=DAL003
+                          reason=reason)
+        self._update_gauge()
+
+    def mark_up(self, rank: int) -> None:
+        # also revives a plan-downed device whose spec had no
+        # revive_after countdown (down-until-mark_up semantics); the
+        # next probe() epoch re-merges the shrunken simulated set
+        faults.revive(int(rank))
+        with self._lock:
+            self._sim_down.discard(int(rank))
+            was = self._manual_down.pop(int(rank), None)
+        if was is not None and _tm.enabled():
+            # cold path: a device transition is an exceptional event
+            _tm.event("elastic", "up", rank=int(rank))  # dalint: disable=DAL003
+        self._update_gauge()
+
+    def probe(self) -> dict:
+        """One health epoch: advance the fault harness's revive clocks,
+        merge its simulated-down set with the manual marks, and report
+        ``{"live": [...], "down": [...], "changed": bool}``."""
+        sim = faults.probe_tick()
+        with self._lock:
+            changed = sim != self._sim_down
+            self._sim_down = set(int(r) for r in sim)
+        self._update_gauge()
+        live, down = self.live_ranks(), sorted(self.down_ranks())
+        _tm.count("elastic.probes")
+        if changed and _tm.enabled():
+            # cold path: only journaled on a health transition
+            _tm.event("elastic", "probe", live=len(live),  # dalint: disable=DAL003
+                      down=down)
+        return {"live": live, "down": down, "changed": changed}
+
+    def _update_gauge(self) -> None:
+        if _tm.enabled():
+            _tm.set_gauge("elastic.live_devices", len(self.live_ranks()))
+            _tm.set_gauge("elastic.down_devices", len(self.down_ranks()))
+
+    # -- re-layout ---------------------------------------------------------
+
+    def shrink(self) -> dict:
+        """Re-lay-out every registered DArray touching a down rank onto
+        the survivors.  Arrays whose data cannot be read (a REAL device
+        loss) are left for the checkpoint-restore path and reported in
+        ``"failed"``."""
+        down = self.down_ranks()
+        live = self.live_ranks()
+        if not live:
+            raise RuntimeError("elastic shrink: no live devices remain")
+        moved, failed = 0, []
+        if down:
+            for d in core.live_arrays():
+                if not ({int(p) for p in d.pids.flat} & down):
+                    continue
+                try:
+                    if relayout(d, live):
+                        moved += 1
+                        with self._lock:
+                            self._shrunk.add(d.id)
+                except Exception as e:  # noqa: BLE001 — reported, not fatal
+                    failed.append({"id": list(d.id),
+                                   "error": f"{type(e).__name__}: {e}"})
+        _tm.count("elastic.shrinks")
+        if _tm.enabled():
+            # cold path: one event per shrink epoch
+            _tm.event("elastic", "shrink", live=len(live),  # dalint: disable=DAL003
+                      down=sorted(down), moved=moved, failed=len(failed))
+            _tm.memory.sample("elastic.shrink")
+        return {"live": live, "moved": moved, "failed": failed}
+
+    def grow(self) -> dict:
+        """After revival: re-lay-out the arrays ``shrink()`` displaced
+        back onto the (recovered) live set — and ONLY those.  Arrays the
+        failure never touched keep the layout their owner chose.  A
+        failed move is reported like shrink's, and the array stays
+        marked so a later grow epoch retries it."""
+        live = self.live_ranks()
+        # the shrink mark clears only once NO device is down: a grow
+        # epoch during a partial revival (or with the device still down)
+        # moves the array to the current live set but must keep it
+        # marked, or the final revival would never re-grow it
+        fully_recovered = not self.down_ranks()
+        with self._lock:
+            shrunk = set(self._shrunk)
+        moved, failed = 0, []
+        for d in core.live_arrays():
+            if d.id not in shrunk:
+                continue
+            try:
+                if relayout(d, live):
+                    moved += 1
+                if fully_recovered:
+                    with self._lock:
+                        self._shrunk.discard(d.id)
+            except Exception as e:  # noqa: BLE001 — reported, not fatal
+                failed.append({"id": list(d.id),
+                               "error": f"{type(e).__name__}: {e}"})
+        # ids whose arrays died since the shrink have nothing to grow
+        with self._lock:
+            self._shrunk &= {d.id for d in core.live_arrays()}
+        _tm.count("elastic.grows")
+        if _tm.enabled():
+            # cold path: one event per grow epoch
+            _tm.event("elastic", "grow", live=len(live),  # dalint: disable=DAL003
+                      moved=moved, failed=len(failed))
+            _tm.memory.sample("elastic.grow")
+        return {"live": live, "moved": moved, "failed": failed}
+
+    def reset(self) -> None:
+        """Forget every health mark and shrink record (tests / fresh
+        sessions)."""
+        with self._lock:
+            self._manual_down.clear()
+            self._sim_down.clear()
+            self._shrunk.clear()
+        self._update_gauge()
+
+
+_manager: ElasticDeviceSet | None = None
+_manager_lock = threading.Lock()
+
+
+def manager() -> ElasticDeviceSet:
+    """The process-wide elastic device-set manager."""
+    global _manager
+    if _manager is None:
+        with _manager_lock:
+            if _manager is None:
+                _manager = ElasticDeviceSet()
+    return _manager

@@ -1,0 +1,388 @@
+"""Deterministic fault injection: kill/hang/revive simulated hosts and devices.
+
+The reference rides Julia ``Distributed`` workers that genuinely die
+mid-job (``ProcessExitedException`` is a first-class citizen of its test
+suite), and BENCH_r01–r05 record this reproduction's accelerator going
+unreachable mid-run.  Surviving that requires *rehearsing* it: this module
+is the seeded chaos harness the resilience stack (``elastic``,
+``recovery``) and the chaos test suite drive their failure scenarios
+through.
+
+Design constraints, in order:
+
+1. **Determinism.**  A fault plan plus a seed must reproduce the exact
+   same failure sequence on every run — otherwise the chaos test's
+   "bit-identical after recovery" acceptance cannot be asserted.  Every
+   decision is a pure function of ``(plan, seed, per-spec invocation
+   count)``: counting is per spec (not global), and probabilistic specs
+   draw a per-``(spec, invocation)`` seeded RNG so thread interleaving
+   between SPMD ranks cannot reorder the stream.  One caveat the math
+   cannot remove: on sites checked concurrently from rank THREADS, a
+   spec that does not pin its victim (no ``match.rank``) fires on
+   whichever rank happens to land on the ``at``-th invocation — the
+   *count* of firings replays exactly, the victim rank does not.  Plans
+   that need full replay on thread-backend sites should pin
+   ``match.rank`` (process-backend ``spmd.rank`` decisions run
+   parent-side in pid order and are immune).
+2. **Zero cost when idle.**  ``check()`` at an injection point is one
+   ``None`` test when no plan is armed — the production posture is
+   "instrumented everywhere, free everywhere".
+3. **Parent-side counting for forked ranks.**  The process SPMD backend
+   forks one child per rank; counters bumped inside a child die with it.
+   Injection points that live inside children therefore split the
+   decision (:func:`decide`, parent-side, persistent) from the action
+   (:func:`act`, child-side) — the thread backend's :func:`check` is
+   simply ``act(decide(...))``.  Collective-site checks still run inside
+   process-backend children, so their counts do not persist across runs
+   on that backend; plans targeting collectives are a thread-backend
+   (and compiled-path) tool.
+
+Instrumented sites (grep ``faults.check``/``faults.decide`` for the
+authoritative list):
+
+========================  ====================================================
+``spmd.rank``             per-rank task start, thread AND process backends
+                          (labels: ``rank``, ``backend``)
+``spmd.collective``       barrier/bcast/scatter/gather_spmd entry
+                          (labels: ``op``, ``rank``)
+``reshard.chunk``         before the chunked collective program of a planned
+                          reshard (labels: ``strategy``, ``op``)
+``checkpoint.write``      between payload write and publish-marker write
+                          (labels: ``store``)
+========================  ====================================================
+
+Plan format (``DA_TPU_FAULT_PLAN`` — inline JSON, or a path to a JSON
+file): a list of spec objects::
+
+    [{"site": "spmd.rank", "match": {"rank": 2}, "action": "device_loss",
+      "at": 1, "count": 1, "device": 2, "revive_after": 2}]
+
+``action``: ``raise`` (InjectedFault), ``device_loss`` (InjectedDeviceLoss
++ the device joins the simulated-down set until ``revive_after`` elastic
+probes have passed), ``hang`` (sleep ``hang_s`` — drives receive
+timeouts), ``exit`` (``os._exit`` in forked ranks: death without a
+report; degrades to ``raise`` in-process).  ``at`` is the 1-based
+matching-invocation index of the first firing, ``count`` how many
+consecutive matching invocations fire (``-1`` = forever), ``p`` an
+optional seeded per-invocation firing probability.
+
+Seed: ``DA_TPU_FAULT_SEED`` (or ``configure(seed=...)``); also feeds
+:func:`jitter`, so retry backoff in ``recovery`` is reproducible under a
+chaos run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import random as _random
+from typing import Any
+
+from .. import telemetry as _tm
+
+__all__ = [
+    "InjectedFault", "InjectedDeviceLoss", "FaultSpec",
+    "configure", "clear", "active", "check", "decide", "act",
+    "history", "simulated_down", "probe_tick", "revive", "jitter",
+]
+
+_SEED_ENV = "DA_TPU_FAULT_SEED"
+_PLAN_ENV = "DA_TPU_FAULT_PLAN"
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the injection harness (not a real failure).
+
+    ``spec`` is the firing :class:`FaultSpec`; ``labels`` the injection
+    point's labels at fire time."""
+
+    def __init__(self, spec: "FaultSpec", labels: dict):
+        self.spec = spec
+        self.labels = dict(labels)
+        super().__init__(
+            f"injected fault at {spec.site} "
+            f"(action={spec.action}, labels={self.labels})")
+
+
+class InjectedDeviceLoss(InjectedFault):
+    """An injected fault simulating a host/device becoming unreachable —
+    classified as *transient device loss* by ``recovery`` (shrink the
+    live set and retry), unlike the generic :class:`InjectedFault`."""
+
+    def __init__(self, spec: "FaultSpec", labels: dict):
+        super().__init__(spec, labels)
+        self.device = spec.device if spec.device is not None \
+            else labels.get("rank")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One entry of a fault plan (see module docstring for semantics)."""
+
+    site: str
+    action: str = "raise"
+    at: int = 1
+    count: int = 1                       # -1 = fire forever once reached
+    match: dict = dataclasses.field(default_factory=dict)
+    device: int | None = None
+    revive_after: int | None = None      # elastic probes until auto-revive
+    hang_s: float = 0.2
+    p: float | None = None               # seeded firing probability
+    index: int = 0                       # position in the plan (set on load)
+
+    @classmethod
+    def from_dict(cls, d: dict, index: int) -> "FaultSpec":
+        known = {f.name for f in dataclasses.fields(cls)}
+        bad = set(d) - known
+        if bad:
+            raise ValueError(f"unknown fault-spec keys {sorted(bad)} "
+                             f"(known: {sorted(known - {'index'})})")
+        spec = cls(**{k: v for k, v in d.items() if k != "index"})
+        spec.index = index
+        if spec.action not in ("raise", "device_loss", "hang", "exit"):
+            raise ValueError(f"unknown fault action {spec.action!r}")
+        if spec.at < 1:
+            raise ValueError(f"fault spec 'at' is 1-based, got {spec.at}")
+        return spec
+
+
+def _mix(seed: int, stream: int, n: int) -> int:
+    """Integer seed mixing for per-(stream, invocation) RNG draws.
+    Plain arithmetic, NOT tuple/str hashing: ``hash()`` of composite
+    seeds is salted per process, which would break cross-process replay
+    of a fault plan (and is deprecated as a Random seed anyway)."""
+    return (seed * 1_000_003 + stream * 8_191 + n) & 0x7FFFFFFFFFFFFFFF
+
+
+class _Injector:
+    """Armed plan + per-spec counters + the simulated-down device set."""
+
+    def __init__(self, specs: list[FaultSpec], seed: int):
+        self.specs = specs
+        self.seed = seed
+        self.lock = threading.RLock()
+        self.counts: dict[int, int] = {}      # spec.index -> invocations
+        self.fired: list[dict] = []           # decision history (fired only)
+        # device -> remaining elastic probes until auto-revive (None =
+        # down until an explicit mark_up)
+        self.down: dict[int, int | None] = {}
+
+    def decide(self, site: str, labels: dict) -> FaultSpec | None:
+        with self.lock:
+            for spec in self.specs:
+                if spec.site != site:
+                    continue
+                if any(labels.get(k) != v for k, v in spec.match.items()):
+                    continue
+                n = self.counts.get(spec.index, 0) + 1
+                self.counts[spec.index] = n
+                if n < spec.at:
+                    continue
+                if spec.count >= 0 and n >= spec.at + spec.count:
+                    continue
+                if spec.p is not None:
+                    # per-(spec, invocation) draw: immune to thread
+                    # interleaving between ranks (determinism rule 1)
+                    r = _random.Random(
+                        _mix(self.seed, spec.index, n)).random()
+                    if r >= spec.p:
+                        continue
+                self.fired.append({"site": site, "spec": spec.index,
+                                   "invocation": n, "action": spec.action,
+                                   "labels": dict(labels)})
+                if spec.action == "device_loss":
+                    dev = spec.device if spec.device is not None \
+                        else labels.get("rank")
+                    if dev is not None:
+                        self.down[int(dev)] = spec.revive_after
+                return spec
+        return None
+
+
+_injector: _Injector | None = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def _load_plan(plan: Any) -> list[FaultSpec]:
+    if isinstance(plan, str):
+        s = plan.strip()
+        if not s.lstrip().startswith("["):
+            s = open(s).read()             # a path to a JSON plan file
+        plan = json.loads(s)
+    if not isinstance(plan, list):
+        raise ValueError("fault plan must be a JSON list of spec objects")
+    return [FaultSpec.from_dict(dict(d), i) for i, d in enumerate(plan)]
+
+
+def configure(plan: Any = None, seed: int | None = None) -> None:
+    """Arm a fault plan (a list of dicts/:class:`FaultSpec`, inline JSON,
+    or a JSON file path).  ``plan=None`` re-reads ``DA_TPU_FAULT_PLAN``/
+    ``DA_TPU_FAULT_SEED`` from the environment."""
+    global _injector, _env_checked
+    if plan is None:
+        plan = os.environ.get(_PLAN_ENV)
+    if seed is None:
+        try:
+            seed = int(os.environ.get(_SEED_ENV, "0"))
+        except ValueError:
+            seed = 0
+    with _lock:
+        _env_checked = True
+        if plan is None:
+            _injector = None
+            return
+        if isinstance(plan, list) and plan and isinstance(plan[0], FaultSpec):
+            specs = list(plan)
+            for i, s in enumerate(specs):
+                s.index = i
+        else:
+            specs = _load_plan(plan)
+        _injector = _Injector(specs, int(seed))
+    if _tm.enabled():
+        _tm.event("faults", "configure", specs=len(specs), seed=int(seed))
+
+
+def clear() -> None:
+    """Disarm fault injection entirely."""
+    global _injector, _env_checked
+    with _lock:
+        _injector = None
+        _env_checked = True
+
+
+def _current() -> _Injector | None:
+    global _env_checked
+    if _injector is None and not _env_checked:
+        # first touch: arm from the environment if a plan is exported
+        # (DA_TPU_FAULT_PLAN without an explicit configure() call).
+        # configure() takes _lock itself, so it must NOT be called with
+        # the lock held; a benign race here at worst re-arms the same
+        # env plan twice.
+        if os.environ.get(_PLAN_ENV):
+            configure()
+        else:
+            _env_checked = True
+    return _injector
+
+
+def active() -> bool:
+    return _current() is not None
+
+
+def decide(site: str, **labels) -> FaultSpec | None:
+    """Advance this site's matching counters and return the spec that
+    fires now, or None.  Decision only — no exception, no sleep; use
+    from a parent process when the action must run elsewhere (forked
+    SPMD ranks)."""
+    inj = _current()
+    if inj is None:
+        return None
+    spec = inj.decide(site, labels)
+    if spec is not None:
+        _tm.count("faults.fired", site=site, action=spec.action)
+        if _tm.enabled():
+            # cold path: a firing fault is an exceptional event by design
+            _tm.event("faults", "fire", site=site, action=spec.action,  # dalint: disable=DAL003
+                      spec=spec.index, **{k: v for k, v in labels.items()
+                                          if isinstance(v, (int, str))})
+    return spec
+
+
+def act(spec: FaultSpec | None, labels: dict | None = None) -> None:
+    """Execute a fired spec's action (no-op for ``None``)."""
+    if spec is None:
+        return
+    labels = labels or {}
+    if spec.action == "hang":
+        time.sleep(spec.hang_s)
+        return
+    if spec.action == "device_loss":
+        raise InjectedDeviceLoss(spec, labels)
+    if spec.action == "exit":
+        # only meaningful in a forked SPMD rank: die without reporting.
+        # In the controller process this degrades to a raise — killing
+        # the controller would take the test harness with it.
+        if os.environ.get("DA_TPU_FAULT_CHILD") == "1":
+            os._exit(1)
+        raise InjectedFault(spec, labels)
+    raise InjectedFault(spec, labels)
+
+
+def check(site: str, **labels) -> None:
+    """Injection-point probe: decide and act in one step (thread-backend
+    and controller-side sites).  One ``None`` test when disarmed."""
+    if _injector is None and _env_checked:
+        return
+    act(decide(site, **labels), labels)
+
+
+def history() -> list[dict]:
+    """Fired-decision history (site, spec index, invocation, action,
+    labels) — the determinism witness: same plan + seed ⇒ same history."""
+    inj = _current()
+    if inj is None:
+        return []
+    with inj.lock:
+        return [dict(f) for f in inj.fired]
+
+
+def simulated_down() -> set[int]:
+    """Device ranks the armed plan currently simulates as unreachable."""
+    inj = _current()
+    if inj is None:
+        return set()
+    with inj.lock:
+        return set(inj.down)
+
+
+def revive(rank: int) -> None:
+    """Explicitly revive a simulated-down device — the escape hatch for
+    ``device_loss`` specs with no ``revive_after`` countdown (``None`` =
+    down until this call).  ``elastic.mark_up`` calls it, so the
+    operator's mark_up works the same for manual and plan-downed
+    devices."""
+    inj = _current()
+    if inj is None:
+        return
+    with inj.lock:
+        if inj.down.pop(int(rank), "absent") != "absent":
+            _tm.count("faults.revives")
+
+
+def probe_tick() -> set[int]:
+    """One elastic health-probe epoch: decrement every downed device's
+    ``revive_after`` countdown, reviving those that reach zero.  Returns
+    the ranks still down after the tick."""
+    inj = _current()
+    if inj is None:
+        return set()
+    with inj.lock:
+        for dev in list(inj.down):
+            left = inj.down[dev]
+            if left is None:
+                continue
+            left -= 1
+            if left <= 0:
+                del inj.down[dev]
+                _tm.count("faults.revives")
+            else:
+                inj.down[dev] = left
+        return set(inj.down)
+
+
+def jitter(scale: float = 1.0) -> float:
+    """A jitter factor in ``[0, scale)`` — seeded (deterministic) while a
+    fault plan is armed, genuinely random otherwise.  Used by recovery
+    backoff so chaos runs replay exactly."""
+    inj = _current()
+    if inj is None:
+        return _random.random() * scale
+    with inj.lock:
+        n = inj.counts.get(-1, 0) + 1
+        inj.counts[-1] = n
+    # stream -1 is reserved for jitter (spec indices are >= 0)
+    return _random.Random(_mix(inj.seed, -1, n)).random() * scale

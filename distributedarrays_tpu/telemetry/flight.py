@@ -39,7 +39,8 @@ import weakref
 from . import core, memory, tracing
 
 __all__ = ["postmortem", "record_crash", "last_bundle",
-           "install_sigusr1", "register_census_provider"]
+           "install_sigusr1", "register_census_provider",
+           "register_classifier", "crash_bundle_count"]
 
 _RING_MAX_ENV = "DA_TPU_FLIGHT_RING"       # bundle ring tail length
 _MAX_ENV = "DA_TPU_FLIGHT_MAX"             # bundles per process
@@ -58,6 +59,7 @@ _crash_bundles = 0                      # record_crash attempts that bundled
 _last_bundle: dict | None = None
 _last_path: str | None = None
 _census_provider = None
+_classifier = None
 _sig_installed = False
 
 
@@ -68,6 +70,23 @@ def register_census_provider(fn) -> None:
     package (telemetry stays stdlib-only / cycle-free)."""
     global _census_provider
     _census_provider = fn
+
+
+def register_classifier(fn) -> None:
+    """Install the failure classifier (``(exc) -> str`` verdict).
+    Registered by ``resilience.recovery`` (same injection pattern as the
+    census provider) so every bundle is stamped with the retry verdict
+    the recovery executor would act on — the bundle drives the retry
+    decision, and offline readers see the same triage."""
+    global _classifier
+    _classifier = fn
+
+
+def crash_bundle_count() -> int:
+    """Crash bundles assembled so far this process (dedup'd per
+    exception object) — the chaos suite's exactly-one-bundle witness."""
+    with _lock:
+        return _crash_bundles
 
 
 def _int_env(name: str, default: int) -> int:
@@ -110,9 +129,16 @@ def snapshot_bundle(reason: str, exc=None) -> dict:
         leak = memory.leak_census()
     except Exception:
         leak = {"error": "leak census failed"}
+    verdict = None
+    if exc is not None and _classifier is not None:
+        try:
+            verdict = _classifier(exc)
+        except Exception:
+            verdict = None               # the recorder must never re-crash
     return {
         "kind": "da_tpu_postmortem",
         "reason": reason,
+        "classification": verdict,
         "host": core._HOST,
         "pid": os.getpid(),
         "wall": round(time.time(), 3),

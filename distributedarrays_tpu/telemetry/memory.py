@@ -47,7 +47,8 @@ from . import core
 
 __all__ = [
     "track", "untrack", "share", "sample",
-    "live_bytes", "peak_bytes", "reset_peak", "tracked_count",
+    "live_bytes", "live_bytes_by_device", "peak_bytes", "reset_peak",
+    "tracked_count",
     "staging", "staging_peak", "snapshot", "entries", "leak_census",
 ]
 
@@ -345,6 +346,13 @@ def live_bytes(device=None) -> int:
         if device is None:
             return _live_total
         return _live_dev.get(device, 0)
+
+
+def live_bytes_by_device() -> dict:
+    """Per-device live-byte map (device id -> bytes) — the elastic
+    manager's witness that a shrunk device's HBM actually drained."""
+    with core._LOCK:
+        return dict(_live_dev)
 
 
 def peak_bytes(device=None) -> int:

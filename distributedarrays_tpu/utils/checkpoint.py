@@ -238,7 +238,12 @@ def load(path: str | os.PathLike) -> Any:
 
 def _write_store(path: Path, meta, arrays, store: str) -> None:
     """Serialize one already-encoded checkpoint into ``path`` (the single
-    body behind both save() and CheckpointManager publication)."""
+    body behind both save() and CheckpointManager publication).
+
+    The metadata file is written LAST: its presence is the publish
+    marker, so an interruption between the payload write and here leaves
+    a *partial* directory that ``CheckpointManager.steps()`` ignores and
+    ``restore()`` falls back past."""
     path.mkdir(parents=True, exist_ok=True)
     if store == "orbax" and arrays:
         import orbax.checkpoint as ocp
@@ -246,6 +251,11 @@ def _write_store(path: Path, meta, arrays, store: str) -> None:
             ckptr.save((path / _ORBAX).resolve(), arrays, force=True)
     elif store == "npz":
         np.savez(path / _ARRS, **arrays)
+    # chaos site: an armed fault plan can kill the write here — payload
+    # on disk, publish marker absent — the "interrupted checkpoint"
+    # failure the restore fallback must survive
+    from ..resilience import faults as _fl
+    _fl.check("checkpoint.write", store=store)
     # (orbax with no array leaves: nothing to store; load mirrors this)
     (path / _META).write_text(
         json.dumps({"__dartpu_store__": store, "tree": meta}))
@@ -389,18 +399,39 @@ class CheckpointManager:
     # -- restore / lifecycle ----------------------------------------------
 
     def restore(self, step: int | None = None) -> Any:
-        """Load ``step`` (default: the latest completed one)."""
+        """Load ``step``; with no step given, the latest *restorable*
+        one.  A partially-published step directory — no publish marker
+        (``steps()`` already skips those), or a marker whose payload is
+        missing/corrupt (a crash or fault mid-write) — is skipped with a
+        journaled fallback to the previous complete step instead of
+        raising mid-restore; an explicitly requested ``step`` stays
+        strict."""
         self.wait()
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no completed checkpoints in {self.directory}")
-        d = self._step_dir(step)
-        if not (d / _META).exists():
-            raise FileNotFoundError(f"no checkpoint for step {step} in "
-                                    f"{self.directory}")
-        return load(d)
+        if step is not None:
+            d = self._step_dir(step)
+            if not (d / _META).exists():
+                raise FileNotFoundError(f"no checkpoint for step {step} in "
+                                        f"{self.directory}")
+            return load(d)
+        done = self.steps()
+        if not done:
+            raise FileNotFoundError(
+                f"no completed checkpoints in {self.directory}")
+        last_exc: BaseException | None = None
+        for s in reversed(done):
+            try:
+                return load(self._step_dir(s))
+            except Exception as e:  # noqa: BLE001 — fall back, then re-raise
+                last_exc = e
+                _tm.count("checkpoint.restore_fallbacks")
+                if _tm.enabled():
+                    # cold path: a partial/corrupt step is exceptional
+                    _tm.event("checkpoint", "restore_fallback",  # dalint: disable=DAL003
+                              step=s, error=f"{type(e).__name__}: "
+                                            f"{str(e)[:200]}")
+        raise FileNotFoundError(
+            f"no restorable checkpoint in {self.directory}: every "
+            f"completed step failed to load") from last_exc
 
     def wait(self) -> None:
         """Block until every pending async save has been published (and

@@ -1,0 +1,618 @@
+"""Chaos suite: deterministic fault injection, elastic shrink/grow,
+retrying executor, and the kill-a-host-mid-spmd acceptance test.
+
+The reference's whole runtime rides Julia Distributed workers that can
+die mid-job; this suite rehearses that failure class against the
+resilience stack (resilience/{faults,elastic,recovery}.py): a seeded
+fault plan must replay exactly, a killed rank/device must recover to a
+bit-identical result via checkpoint restore + re-layout onto survivors,
+divergence must never be retried, and the per-test leak gate (conftest)
+must still drain the registry and HBM ledger to zero afterwards.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import parallel, telemetry as tm
+from distributedarrays_tpu.analysis.divergence import \
+    CollectiveDivergenceError
+from distributedarrays_tpu.parallel import spmd_mode as S
+from distributedarrays_tpu.resilience import elastic, faults, recovery
+from distributedarrays_tpu.telemetry import flight
+from distributedarrays_tpu.telemetry import memory as tmem
+from distributedarrays_tpu.utils.checkpoint import CheckpointManager
+
+_HAS_FORK = hasattr(os, "fork")
+process_only = pytest.mark.skipif(not _HAS_FORK, reason="needs POSIX fork")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    """Every test starts and ends with fault injection disarmed, the
+    elastic manager pristine, and the flight recorder's per-process
+    crash-bundle cap/dedup reset (all process-wide singletons) — so
+    each test's exactly-one-bundle assertion counts only its own
+    failures, not the suite's accumulated ones."""
+    faults.clear()
+    elastic.manager().reset()
+    flight._reset()
+    yield
+    faults.clear()
+    elastic.manager().reset()
+    flight._reset()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("base_delay", 0.005)
+    kw.setdefault("max_delay", 0.02)
+    return recovery.RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# faults.py: the deterministic harness itself
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_determinism_seeded():
+    # identical plan + seed => identical fired-decision history,
+    # including the probabilistic spec (per-(spec, invocation) draws)
+    plan = [
+        {"site": "spmd.rank", "match": {"rank": 1}, "action": "raise",
+         "at": 2, "count": 2},
+        {"site": "spmd.collective", "action": "raise", "at": 1,
+         "count": -1, "p": 0.5},
+    ]
+
+    def drive():
+        hist = []
+        for i in range(6):
+            for rank in range(4):
+                for site, labels in (
+                        ("spmd.rank", {"rank": rank, "backend": "thread"}),
+                        ("spmd.collective", {"op": "barrier",
+                                             "rank": rank})):
+                    spec = faults.decide(site, **labels)
+                    if spec is not None:
+                        hist.append((site, spec.index))
+        return hist, faults.history()
+
+    faults.configure(plan=plan, seed=77)
+    h1, full1 = drive()
+    faults.configure(plan=plan, seed=77)
+    h2, full2 = drive()
+    assert h1 == h2 and full1 == full2
+    assert any(s == "spmd.rank" for s, _ in h1)       # the 'at' window fired
+    # a different seed flips at least one probabilistic decision
+    faults.configure(plan=plan, seed=78)
+    h3, _ = drive()
+    assert [x for x in h3 if x[0] == "spmd.collective"] != \
+        [x for x in h1 if x[0] == "spmd.collective"]
+
+
+def test_fault_plan_json_env_roundtrip(monkeypatch):
+    monkeypatch.setenv(
+        "DA_TPU_FAULT_PLAN",
+        '[{"site": "reshard.chunk", "action": "raise", "at": 1}]')
+    monkeypatch.setenv("DA_TPU_FAULT_SEED", "9")
+    faults.configure()                    # re-read from the environment
+    assert faults.active()
+    with pytest.raises(faults.InjectedFault):
+        faults.check("reshard.chunk", strategy="all_to_all")
+
+
+def test_fault_plan_rejects_unknown_keys():
+    with pytest.raises(ValueError, match="unknown fault-spec keys"):
+        faults.configure(plan=[{"site": "x", "frobnicate": 1}])
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.configure(plan=[{"site": "x", "action": "explode"}])
+
+
+def test_device_loss_marks_simulated_down_and_revives():
+    faults.configure(plan=[{"site": "spmd.rank", "match": {"rank": 3},
+                            "action": "device_loss", "at": 1,
+                            "device": 3, "revive_after": 2}], seed=1)
+    with pytest.raises(faults.InjectedDeviceLoss):
+        faults.check("spmd.rank", rank=3, backend="thread")
+    assert faults.simulated_down() == {3}
+    assert faults.probe_tick() == {3}     # 1st probe: countdown 2 -> 1
+    assert faults.probe_tick() == set()   # 2nd probe: revived
+    assert faults.simulated_down() == set()
+
+
+def test_mark_up_revives_plan_downed_device_without_countdown():
+    # revive_after omitted => down until an explicit mark_up; the
+    # operator's mark_up must work for plan-downed devices too
+    faults.configure(plan=[{"site": "spmd.rank", "match": {"rank": 4},
+                            "action": "device_loss", "at": 1,
+                            "device": 4}], seed=1)
+    with pytest.raises(faults.InjectedDeviceLoss):
+        faults.check("spmd.rank", rank=4, backend="thread")
+    m = elastic.manager()
+    m.probe()
+    assert 4 not in m.live_ranks()
+    assert faults.probe_tick() == {4}     # no countdown: stays down
+    m.mark_up(4)
+    m.probe()
+    assert 4 in m.live_ranks()
+    assert faults.simulated_down() == set()
+
+
+def test_jitter_deterministic_under_plan():
+    faults.configure(plan=[{"site": "x"}], seed=5)
+    a = [faults.jitter() for _ in range(4)]
+    faults.configure(plan=[{"site": "x"}], seed=5)
+    b = [faults.jitter() for _ in range(4)]
+    assert a == b
+    assert all(0.0 <= v < 1.0 for v in a)
+
+
+# ---------------------------------------------------------------------------
+# rank death: recovery on both spmd backends
+# ---------------------------------------------------------------------------
+
+
+def _rank_death_roundtrip(backend):
+    faults.configure(plan=[{"site": "spmd.rank", "match": {"rank": 1},
+                            "action": "raise", "at": 1, "count": 1}],
+                     seed=1234)
+    attempts = []
+
+    def run():
+        attempts.append(1)
+        return parallel.spmd(lambda: S.myid() * 10, pids=[0, 1, 2, 3],
+                             backend=backend)
+
+    out = recovery.run_with_recovery(run, policy=_fast_policy())
+    assert out == [0, 10, 20, 30]
+    assert len(attempts) == 2             # one failure, one clean retry
+
+
+def test_rank_death_recovery_thread_backend():
+    retries0 = tm.counter_value("recovery.retries", verdict="transient")
+    _rank_death_roundtrip("thread")
+    assert tm.counter_value("recovery.retries",
+                            verdict="transient") == retries0 + 1
+
+
+@process_only
+def test_rank_death_recovery_process_backend():
+    # decisions are parent-side, so the plan's count=1 is consumed on the
+    # first (failing) run even though the raise happened inside a fork
+    _rank_death_roundtrip("process")
+
+
+@process_only
+def test_rank_death_without_report_process_backend():
+    # action "exit": the forked rank dies without reporting (os._exit);
+    # the parent's "died without reporting" error is transient-retryable
+    faults.configure(plan=[{"site": "spmd.rank", "match": {"rank": 2},
+                            "action": "exit", "at": 1, "count": 1}],
+                     seed=1)
+    out = recovery.run_with_recovery(
+        lambda: parallel.spmd(lambda: S.myid(), pids=[0, 1, 2],
+                              backend="process"),
+        policy=_fast_policy())
+    assert out == [0, 1, 2]
+
+
+def test_collective_fault_site_fires():
+    faults.configure(plan=[{"site": "spmd.collective",
+                            "match": {"op": "barrier", "rank": 2},
+                            "action": "raise", "at": 1, "count": 1}],
+                     seed=1)
+
+    def prog():
+        S.barrier()
+        return True
+
+    with pytest.raises(RuntimeError) as ei:
+        parallel.spmd(prog, pids=[0, 1, 2, 3])
+    assert isinstance(ei.value.__cause__, faults.InjectedFault)
+    assert all(parallel.spmd(prog, pids=[0, 1, 2, 3]))   # count consumed
+
+
+def test_spmd_timeout_env_and_tag_in_message(monkeypatch):
+    # satellite: DA_TPU_SPMD_TIMEOUT configures the receive timeout in
+    # the error message together with the blocked tag
+    monkeypatch.setenv("DA_TPU_SPMD_TIMEOUT", "0.3")
+
+    def stuck():
+        if S.myid() == 0:
+            S.recvfrom(1, tag="never-sent")
+
+    with pytest.raises(RuntimeError) as ei:
+        parallel.spmd(stuck, pids=[0, 1], timeout=30)
+    msg = str(ei.value.__cause__)
+    assert "DA_TPU_SPMD_TIMEOUT=0.3" in msg
+    assert "tag='never-sent'" in msg
+    assert "0.3s" in msg
+    # source attribution stays honest: an explicit timeout= argument is
+    # credited to the caller, not the env var it overrode; an invalid
+    # env value is named as invalid, not as the configured source
+    assert S._timeout_source(5.0) == "explicit timeout argument"
+    monkeypatch.setenv("DA_TPU_SPMD_TIMEOUT", "5m")
+    assert "invalid" in S._timeout_source(60.0)
+    monkeypatch.delenv("DA_TPU_SPMD_TIMEOUT")
+    assert "default" in S._timeout_source(60.0)
+
+
+def test_hang_action_trips_receive_timeout(monkeypatch):
+    monkeypatch.setenv("DA_TPU_SPMD_TIMEOUT", "0.2")
+    faults.configure(plan=[{"site": "spmd.collective",
+                            "match": {"op": "barrier", "rank": 1},
+                            "action": "hang", "hang_s": 1.0,
+                            "at": 1, "count": 1}], seed=1)
+
+    def prog():
+        S.barrier()
+
+    with pytest.raises(RuntimeError) as ei:
+        parallel.spmd(prog, pids=[0, 1], timeout=30)
+    assert isinstance(ei.value.__cause__, TimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# elastic: shrink -> re-layout -> grow
+# ---------------------------------------------------------------------------
+
+
+def test_shrink_relayout_grow_roundtrip(rng):
+    A = rng.standard_normal((64, 8)).astype(np.float32)
+    B = rng.standard_normal((40,)).astype(np.float32)   # uneven on 7
+    d1 = dat.distribute(A)
+    d2 = dat.distribute(B)
+    m = elastic.manager()
+    assert m.live_ranks() == list(range(8))
+
+    m.mark_down(5)
+    res = m.shrink()
+    assert res["failed"] == []
+    assert res["moved"] >= 1
+    for d in (d1, d2):
+        assert 5 not in {int(p) for p in d.pids.flat}
+    # the HBM ledger drained the downed device as the re-layout went
+    assert tmem.live_bytes_by_device().get(5, 0) == 0
+    # registry unchanged: same ids, same live set
+    assert {d1.id, d2.id} <= set(dat.registry().keys())
+    assert np.array_equal(np.asarray(d1), A)
+    assert np.array_equal(np.asarray(d2), B)
+
+    m.mark_up(5)
+    m.grow()
+    assert 5 in {int(p) for p in d1.pids.flat}
+    assert np.array_equal(np.asarray(d1), A)
+    assert np.array_equal(np.asarray(d2), B)
+    d1.close()
+    d2.close()
+    # leak gate: registry and ledger drained clean (conftest re-asserts)
+    assert dat.live_ids() == []
+    assert tmem.live_bytes() == 0
+
+
+def test_grow_leaves_untouched_custom_layouts_alone(rng):
+    # an array on a deliberate 2-rank subset that the failure never
+    # displaced must NOT be spread over all 8 ranks by grow()
+    A = rng.standard_normal((32, 8)).astype(np.float32)
+    custom = dat.distribute(A, procs=[0, 1], dist=(2, 1))
+    full = dat.distribute(A)
+    m = elastic.manager()
+    m.mark_down(7)                        # touches `full`, not `custom`
+    m.shrink()
+    m.mark_up(7)
+    res = m.grow()
+    assert res["failed"] == []
+    assert sorted({int(p) for p in custom.pids.flat}) == [0, 1]
+    assert 7 in {int(p) for p in full.pids.flat}
+    assert np.array_equal(np.asarray(custom), A)
+    custom.close()
+    full.close()
+
+
+def test_shrink_requires_survivors():
+    m = elastic.manager()
+    for r in range(8):
+        m.mark_down(r)
+    with pytest.raises(RuntimeError, match="no live devices"):
+        m.shrink()
+
+
+def test_relayout_noop_when_layout_already_matches(rng):
+    d = dat.distribute(rng.standard_normal((32, 8)).astype(np.float32))
+    assert elastic.relayout(d, list(range(8))) is False
+    d.close()
+
+
+def test_reshard_chunk_fault_aborts_collective(rng):
+    from distributedarrays_tpu.parallel import reshard as R
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributedarrays_tpu import layout as L
+
+    mesh = L.mesh_for(range(8), (8,))
+    x = jax.device_put(np.arange(64 * 8, dtype=np.float32).reshape(64, 8),
+                       NamedSharding(mesh, P("d0", None)))
+    dst = NamedSharding(mesh, P(None, "d0"))
+    faults.configure(plan=[{"site": "reshard.chunk", "action": "raise",
+                            "at": 1, "count": 1}], seed=1)
+    with pytest.raises(faults.InjectedFault):
+        R.reshard(x, dst)
+    # count consumed: the retry goes through
+    y = R.reshard(x, dst)
+    assert np.array_equal(np.asarray(y), np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: interrupted publication and restore fallback
+# ---------------------------------------------------------------------------
+
+
+def test_restore_skips_partial_step_dirs(tmp_path, rng):
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        mgr.save(1, {"v": 1, "x": dat.distribute(A)})
+        mgr.save(2, {"v": 2, "x": dat.distribute(A * 2)})
+        # a partially-published step: directory exists, no publish marker
+        (tmp_path / "step_00000003").mkdir()
+        (tmp_path / "step_00000003" / "arrays.npz").write_bytes(b"junk")
+        # and one WITH a marker but corrupt payload (crash mid-copy)
+        bad = tmp_path / "step_00000004"
+        bad.mkdir()
+        (bad / "dartpu_meta.json").write_text(
+            '{"__dartpu_store__": "npz", "tree": {"__dartpu__": "ndarray",'
+            ' "key": "a0", "jax": false}}')
+        # no arrays.npz: load() must fail and fall back
+        assert mgr.steps() == [1, 2, 4]
+        fb0 = tm.counter_value("checkpoint.restore_fallbacks")
+        state = mgr.restore()
+        assert state["v"] == 2
+        assert np.array_equal(np.asarray(state["x"]), A * 2)
+        assert tm.counter_value("checkpoint.restore_fallbacks") == fb0 + 1
+        # explicit step stays strict
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(step=7)
+    dat.d_closeall()
+
+
+def test_interrupted_checkpoint_write_leaves_previous_restorable(
+        tmp_path, rng):
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        mgr.save(1, {"step": 1, "x": dat.distribute(A)})
+        faults.configure(plan=[{"site": "checkpoint.write",
+                                "action": "raise", "at": 1, "count": 1}],
+                         seed=1)
+        with pytest.raises(faults.InjectedFault):
+            mgr.save(2, {"step": 2, "x": dat.distribute(A * 3)})
+        # the interrupted step never published; restore sees step 1
+        assert mgr.steps() == [1]
+        state = mgr.restore()
+        assert state["step"] == 1
+        # and the step is retryable after the fault window closes
+        mgr.save(2, {"step": 2, "x": dat.distribute(A * 3)})
+        assert mgr.restore()["step"] == 2
+    dat.d_closeall()
+
+
+# ---------------------------------------------------------------------------
+# recovery: verdicts and the retry discipline
+# ---------------------------------------------------------------------------
+
+
+def test_divergence_is_never_retried():
+    calls = []
+
+    def diverges():
+        calls.append(1)
+        raise CollectiveDivergenceError("rank sequences differ")
+
+    g0 = tm.counter_value("recovery.giveups", verdict="divergence")
+    with pytest.raises(CollectiveDivergenceError):
+        recovery.run_with_recovery(diverges, policy=_fast_policy())
+    assert len(calls) == 1                # exactly one attempt, no retry
+    assert tm.counter_value("recovery.giveups",
+                            verdict="divergence") == g0 + 1
+
+
+def test_timeout_retried_once_with_fresh_mesh():
+    calls = []
+    fm0 = tm.counter_value("recovery.fresh_mesh")
+
+    def times_out():
+        calls.append(1)
+        raise TimeoutError("spmd task did not finish")
+
+    with pytest.raises(TimeoutError):
+        recovery.run_with_recovery(times_out, policy=_fast_policy())
+    assert len(calls) == 2                # original + exactly one retry
+    assert tm.counter_value("recovery.fresh_mesh") == fm0 + 1
+
+
+def test_transient_retries_bounded():
+    calls = []
+
+    def always_fails():
+        calls.append(1)
+        raise ValueError("flaky")
+
+    with pytest.raises(ValueError):
+        recovery.run_with_recovery(
+            always_fails, policy=_fast_policy(max_retries=2))
+    assert len(calls) == 3                # 1 + max_retries
+
+
+def test_classify_walks_cause_chain():
+    try:
+        try:
+            raise faults.InjectedDeviceLoss(
+                faults.FaultSpec(site="spmd.rank", action="device_loss"),
+                {"rank": 1})
+        except faults.InjectedDeviceLoss as inner:
+            raise RuntimeError("spmd task on rank 1 failed") from inner
+    except RuntimeError as wrapped:
+        assert recovery.classify(wrapped) == "device_loss"
+    assert recovery.classify(TimeoutError("x")) == "timeout"
+    assert recovery.classify(ValueError("x")) == "transient"
+    assert recovery.classify(
+        CollectiveDivergenceError("boom")) == "divergence"
+    # process-backend style: the verdict survives stringification
+    assert recovery.classify(RuntimeError(
+        "child traceback:\nInjectedDeviceLoss: injected fault at "
+        "spmd.rank")) == "device_loss"
+
+
+def test_bundle_is_stamped_with_classification():
+    if not tm.enabled():
+        pytest.skip("telemetry disabled")
+    err = TimeoutError("collective stuck")
+    tm.flight.record_crash(err, where="test")
+    b = flight.last_bundle()
+    assert b is not None and b["classification"] == "timeout"
+
+
+def test_retry_without_completed_checkpoint_does_not_mask_failure(
+        tmp_path):
+    # a transient failure BEFORE the first save() completes: the retry
+    # loop must skip the restore (nothing to restore) and still retry,
+    # not abort with the checkpoint's FileNotFoundError
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise ValueError("first-step blip")
+            return "ok"
+
+        out = recovery.run_with_recovery(
+            flaky, policy=_fast_policy(), checkpoints=mgr,
+            restore_fn=lambda tree: None)
+        assert out == "ok"
+        assert len(calls) == 2
+
+
+def test_grow_retries_until_device_actually_revives(rng):
+    # a grow epoch while the device is STILL down must keep the shrink
+    # mark, so the eventual revival epoch re-grows the array
+    A = rng.standard_normal((64, 8)).astype(np.float32)
+    d = dat.distribute(A)
+    m = elastic.manager()
+    m.mark_down(6)
+    m.shrink()
+    assert 6 not in {int(p) for p in d.pids.flat}
+    m.grow()                              # premature: 6 still down
+    assert 6 not in {int(p) for p in d.pids.flat}
+    m.mark_up(6)
+    m.grow()                              # real revival epoch
+    assert 6 in {int(p) for p in d.pids.flat}
+    assert np.array_equal(np.asarray(d), A)
+    d.close()
+
+
+def test_restore_fn_reseats_state(tmp_path):
+    with CheckpointManager(tmp_path, async_save=False) as mgr:
+        mgr.save(0, {"value": 41})
+        seen = []
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise ValueError("transient blip")
+            return "ok"
+
+        out = recovery.run_with_recovery(
+            flaky, policy=_fast_policy(), checkpoints=mgr,
+            restore_fn=lambda tree: seen.append(tree["value"]))
+        assert out == "ok"
+        assert seen == [41]               # restored exactly once
+
+
+# ---------------------------------------------------------------------------
+# the acceptance chaos test: kill + revive a simulated host mid-spmd
+# ---------------------------------------------------------------------------
+
+
+def _chaos_workload(tmp_path, plan, seed):
+    """One full run: distribute, checkpoint step 0, spmd-mutate every
+    localpart (*2 + 1, elementwise so the result is layout-independent),
+    recover through the retrying executor, revive + grow, gather."""
+    faults.clear()
+    elastic.manager().reset()
+    if plan is not None:
+        faults.configure(plan=plan, seed=seed)
+    A = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+    d = dat.distribute(A.copy())
+    mgr = CheckpointManager(tmp_path, async_save=False)
+    mgr.save(0, {"x": d})
+    state = {"d": d}
+
+    def reseat(tree):
+        state["d"].close()                # drop the partially-mutated run
+        state["d"] = tree["x"]
+
+    def attempt():
+        dd = state["d"]
+        ranks = sorted({int(p) for p in dd.pids.flat})
+
+        def f():
+            lp = np.asarray(dd.localpart())
+            if lp.size:
+                dd.set_localpart(lp * 2 + 1)
+
+        parallel.spmd(f, pids=ranks)
+        return np.asarray(dd)
+
+    out = recovery.run_with_recovery(
+        attempt, policy=_fast_policy(), checkpoints=mgr, restore_fn=reseat)
+    # revival epoch: the simulated device comes back, arrays grow back
+    probe = elastic.manager().probe()
+    elastic.manager().grow()
+    mgr.close()
+    state["d"].close()
+    return out, probe
+
+
+def test_chaos_kill_and_revive_host_mid_spmd(tmp_path):
+    plan = [{"site": "spmd.rank", "match": {"rank": 2},
+             "action": "device_loss", "at": 1, "count": 1,
+             "device": 2, "revive_after": 2}]
+    b0 = flight.crash_bundle_count()
+    r0 = tm.counter_value("recovery.retries", verdict="device_loss")
+    s0 = tm.counter_value("recovery.restores")
+    k0 = tm.counter_value("elastic.shrinks")
+
+    faulty, probe = _chaos_workload(tmp_path / "chaos", plan, seed=1234)
+
+    # exactly ONE flight bundle for the one recovered failure
+    assert flight.crash_bundle_count() - b0 == 1
+    # the recovery counters recorded the shrink-and-retry path
+    assert tm.counter_value("recovery.retries",
+                            verdict="device_loss") == r0 + 1
+    assert tm.counter_value("recovery.restores") == s0 + 1
+    assert tm.counter_value("elastic.shrinks") == k0 + 1
+    # the simulated host revived at the post-run probe epoch
+    assert probe["down"] == []
+
+    clean, _ = _chaos_workload(tmp_path / "clean", None, seed=0)
+    # bit-identical convergence: elementwise workload, so layout churn
+    # (8 -> 7 survivors -> 8 revived) must not change a single bit
+    assert faulty.dtype == clean.dtype
+    assert np.array_equal(faulty, clean)
+    # leak gate: everything drained (conftest re-asserts after teardown)
+    assert dat.live_ids() == []
+    assert tmem.live_bytes() == 0
+
+
+def test_chaos_replay_is_deterministic(tmp_path):
+    plan = [{"site": "spmd.rank", "match": {"rank": 1},
+             "action": "device_loss", "at": 1, "count": 1,
+             "device": 1, "revive_after": 2}]
+    out1, _ = _chaos_workload(tmp_path / "a", plan, seed=42)
+    h1 = faults.history()
+    out2, _ = _chaos_workload(tmp_path / "b", plan, seed=42)
+    h2 = faults.history()
+    assert np.array_equal(out1, out2)
+    assert h1 == h2

@@ -25,6 +25,36 @@ import jax.numpy as jnp
 x = jnp.ones((256,256), dtype=jnp.bfloat16)
 print('probe-ok', d[0].platform, float((x@x)[0,0]))
 " >> "$LOG" 2>&1; then
+    echo "=== TUNNEL ALIVE $(date -u) — shrink/grow smoke ===" >> "$LOG"
+    # Elastic smoke BEFORE benching: distribute a small array over every
+    # visible device, force a shrink onto survivors and a grow back, and
+    # require the round-trip to be lossless with the registry/ledger
+    # drained.  A device set that cannot survive this is degraded (a
+    # chip dropped off the tunnel mid-window) — benching it would bank a
+    # row whose device count silently differs from the provenance.
+    if ! timeout 300 python -c "
+import numpy as np
+import distributedarrays_tpu as dat
+from distributedarrays_tpu.resilience import elastic
+from distributedarrays_tpu.telemetry import memory as tmem
+m = elastic.manager()
+ranks = m.all_ranks()
+assert ranks, 'no devices visible'
+A = np.arange(256 * 8, dtype=np.float32).reshape(256, 8)
+d = dat.distribute(A)
+if len(ranks) > 1:
+    m.mark_down(ranks[-1]); m.shrink()
+    assert np.array_equal(np.asarray(d), A), 'shrink lost data'
+    m.mark_up(ranks[-1]); m.grow()
+assert np.array_equal(np.asarray(d), A), 'grow lost data'
+d.close()
+assert dat.live_ids() == [] and tmem.live_bytes() == 0, 'leak after smoke'
+print('elastic-smoke-ok', len(ranks), 'devices')
+" >> "$LOG" 2>&1; then
+      echo "=== elastic smoke FAILED — degraded device set, continuing probes ===" >> "$LOG"
+      sleep 480
+      continue
+    fi
     echo "=== TUNNEL ALIVE $(date -u) — running bench ===" >> "$LOG"
     # bench self-limits 300s under the kill so it exits cleanly (rc=0)
     # with everything banked instead of dying rc=124 mid-config.
