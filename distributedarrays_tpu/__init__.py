@@ -36,6 +36,7 @@ from .ops.sort import dsort
 from .ops.sparse import dnnz, ddata_bcoo
 from . import parallel
 from . import resilience
+from . import serve
 from . import telemetry
 
 __version__ = "0.1.0"

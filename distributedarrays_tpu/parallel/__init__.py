@@ -4,4 +4,4 @@ from .collectives import (axis_rank, axis_size, halo_exchange, pall_to_all,
                           run_spmd, spmd_mesh)
 from .spmd_mode import (SPMDContext, barrier, bcast, close_context, context,
                    context_local_storage, gather_spmd, myid, nprocs,
-                   recvfrom, recvfrom_any, scatter, sendto, spmd)
+                   recvfrom, recvfrom_any, scatter, sendto, spmd, spmd_async)

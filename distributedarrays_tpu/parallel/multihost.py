@@ -18,6 +18,8 @@ the reference running its full test suite on local `addprocs` workers.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -27,7 +29,8 @@ from .. import layout as L
 from .. import telemetry as _tm
 
 __all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
-           "host_local_slice", "gather_global"]
+           "host_local_slice", "gather_global", "heartbeat",
+           "down_peer_processes"]
 
 
 def initialize(coordinator_address: str | None = None,
@@ -62,6 +65,69 @@ def initialize(coordinator_address: str | None = None,
         if "coordinator_address" in str(e):
             return
         raise
+
+
+def _kv_client():
+    """The ``jax.distributed`` coordination-service KV client, or None
+    when single-process / not initialized — the heartbeat helpers
+    degrade to no-ops so the same program runs on a laptop and a pod."""
+    try:
+        if jax.process_count() <= 1:
+            return None
+        from jax._src import distributed as _dist  # pragma: no cover
+        return getattr(_dist.global_state, "client", None)  # pragma: no cover
+    except Exception:
+        return None
+
+
+_HB_PREFIX = "dat/heartbeat/"
+
+
+def heartbeat() -> bool:
+    """Publish this controller process's liveness timestamp to the
+    coordination service's KV store.  Call it periodically (the elastic
+    manager's probe loop does); peers read it via
+    :func:`down_peer_processes`.  Returns False (no-op) single-process
+    or when the distributed client is unavailable."""
+    client = _kv_client()
+    if client is None:
+        return False
+    try:  # pragma: no cover — needs a real multi-controller job
+        client.key_value_set(f"{_HB_PREFIX}{jax.process_index()}",
+                             f"{time.time():.3f}", allow_overwrite=True)
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+def down_peer_processes(stale_s: float = 30.0) -> set[int]:
+    """Peer controller process indices whose heartbeat is absent or older
+    than ``stale_s`` — the multihost half of the elastic manager's REAL
+    health signal.  Single-process (or no distributed client): empty set,
+    nothing is ever reported down from here."""
+    client = _kv_client()
+    if client is None:
+        return set()
+    down: set[int] = set()
+    me = jax.process_index()  # pragma: no cover — needs real multi-host
+    for p in range(jax.process_count()):  # pragma: no cover
+        if p == me:
+            continue
+        try:
+            raw = client.key_value_try_get(f"{_HB_PREFIX}{p}")
+        except Exception as e:
+            # only an ABSENT key is evidence of a dead peer; a transport/
+            # client error says nothing about the peer and must not down
+            # the whole fleet in one hiccup epoch
+            if "NOT_FOUND" in str(e).upper().replace(" ", "_"):
+                down.add(p)
+            continue
+        try:
+            if not raw or time.time() - float(raw) > stale_s:
+                down.add(p)
+        except ValueError:
+            down.add(p)        # unparsable heartbeat = no heartbeat
+    return down  # pragma: no cover
 
 
 def process_info() -> dict:

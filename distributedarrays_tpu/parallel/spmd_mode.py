@@ -42,9 +42,9 @@ from ..analysis import divergence as _dv
 from ..resilience import faults as _fl
 
 __all__ = [
-    "spmd", "sendto", "recvfrom", "recvfrom_any", "barrier", "bcast",
-    "scatter", "gather_spmd", "context", "context_local_storage", "myid",
-    "nprocs", "SPMDContext", "close_context",
+    "spmd", "spmd_async", "sendto", "recvfrom", "recvfrom_any", "barrier",
+    "bcast", "scatter", "gather_spmd", "context", "context_local_storage",
+    "myid", "nprocs", "SPMDContext", "close_context",
 ]
 
 _TIMEOUT_ENV = "DA_TPU_SPMD_TIMEOUT"
@@ -488,6 +488,30 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
     if backend != "thread":
         raise ValueError(f"unknown spmd backend {backend!r} "
                          "(expected 'thread' or 'process')")
+    dirty = False
+    try:
+        results = _fanout_thread_ranks(ctx, f, args, timeout, checker)
+    except BaseException:
+        # failed or timed-out run: drain stale messages and resync
+        # barrier generations so an explicit context stays usable
+        dirty = True
+        raise
+    finally:
+        ctx._divergence = None
+        if implicit:
+            ctx.close()
+        elif dirty:
+            ctx._reset_comm()
+    return [results[p] for p in ctx.pids]
+
+
+def _fanout_thread_ranks(ctx: SPMDContext, f: Callable, args: tuple,
+                         timeout: float, checker) -> dict[int, Any]:
+    """The thread backend's rank fan-out, extracted from the driver so the
+    blocking :func:`spmd` and the async dispatch path share one engine:
+    one daemon thread per rank, a single shared deadline, peer-abort
+    wakeups, and root-cause error aggregation.  Returns ``{rank:
+    result}``; raises (after recording a flight bundle) on any failure."""
     results: dict[int, Any] = {}
     errors: dict[int, BaseException] = {}
 
@@ -522,25 +546,16 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
     # one shared deadline: the documented timeout bounds the whole run, not
     # each join (nranks sequential joins would multiply the worst case)
     deadline = time.monotonic() + timeout
-    try:
-        for t in threads:
-            t.join(max(0.0, deadline - time.monotonic()))
-            if t.is_alive():
-                ctx._failed.set()      # wake blocked receivers
-                for t2 in threads:
-                    t2.join(5)
-                err = TimeoutError(
-                    f"spmd task {t.name} did not finish in {timeout}s")
-                _record_crash(err)
-                raise err
-    finally:
-        ctx._divergence = None
-        if implicit:
-            ctx.close()
-        elif errors or any(t.is_alive() for t in threads):
-            # failed or timed-out run: drain stale messages and resync
-            # barrier generations so the explicit context stays usable
-            ctx._reset_comm()
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            ctx._failed.set()      # wake blocked receivers
+            for t2 in threads:
+                t2.join(5)
+            err = TimeoutError(
+                f"spmd task {t.name} did not finish in {timeout}s")
+            _record_crash(err)
+            raise err
     if errors:
         def _secondary(e):
             # failures that are consequences, not causes: peer aborts,
@@ -567,4 +582,51 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
         ) from err
     if checker is not None:
         checker.verify()   # backstop: identical sequences end to end
-    return [results[p] for p in ctx.pids]
+    return results
+
+
+# ---------------------------------------------------------------------------
+# async dispatch
+# ---------------------------------------------------------------------------
+
+_DISPATCHERS_ENV = "DA_TPU_SPMD_DISPATCHERS"
+_dispatch_pool = None
+_dispatch_lock = threading.Lock()
+
+
+def _dispatcher():
+    """The shared async-dispatch pool (daemon threads; size
+    ``DA_TPU_SPMD_DISPATCHERS``, default 4).  Lazy: a process that only
+    ever calls blocking :func:`spmd` never creates it."""
+    global _dispatch_pool
+    if _dispatch_pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+        with _dispatch_lock:
+            if _dispatch_pool is None:
+                try:
+                    n = int(os.environ.get(_DISPATCHERS_ENV, "4"))
+                except ValueError:
+                    n = 4
+                _dispatch_pool = ThreadPoolExecutor(
+                    max_workers=max(1, n),
+                    thread_name_prefix="spmd-dispatch")
+    return _dispatch_pool
+
+
+def spmd_async(f: Callable, *args, pids: Sequence[int] | None = None,
+               context: SPMDContext | None = None, timeout: float = 300.0,
+               backend: str = "thread"):
+    """Asynchronous :func:`spmd`: enqueue the run on the shared dispatch
+    pool and return a ``concurrent.futures.Future`` resolving to the
+    pid-ordered per-rank results (or raising exactly what ``spmd`` would).
+
+    This is the async half of the serving refactor: dispatchers overlap
+    independent runs (up to ``DA_TPU_SPMD_DISPATCHERS`` concurrently)
+    instead of the caller blocking through each eager fan-out — the
+    serving executor and any pipelined workload submit here.  Runs on
+    the same explicit ``context`` are NOT serialized by this function;
+    overlapping them has the same semantics as overlapping threads did.
+    """
+    return _dispatcher().submit(
+        lambda: spmd(f, *args, pids=pids, context=context, timeout=timeout,
+                     backend=backend))

@@ -153,6 +153,24 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
         core._rank_tls.rank = rank
         spmd_mode._tls.ctxt = rctx
         os.environ["DA_TPU_FAULT_CHILD"] = "1"   # arms the "exit" action
+        # graceful-shutdown signal: a SIGTERM (forwarded by the parent, or
+        # delivered directly by a process-group kill) raises INSIDE the
+        # rank's compute, so the child drains its inbox and reports a
+        # clear "received SIGTERM" failure instead of dying mid-collective
+        # and leaving its peers to a cryptic receive timeout.  After fork
+        # the forked thread IS the child's main thread, so installing the
+        # handler here is legal.
+        import signal
+
+        def _on_sigterm(signum, frame):
+            raise RuntimeError(
+                f"SPMD worker rank {rank} received SIGTERM: draining and "
+                "reporting before exit")
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):  # pragma: no cover — exotic platform
+            pass
         try:
             try:
                 _fl.act(dooms.get(rank),
@@ -202,6 +220,35 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
             "ignore", message=".*fork.*", category=RuntimeWarning)
         for p in procs:
             p.start()
+
+    # forward SIGTERM to the children for the run's duration so a
+    # controller shutdown (systemd stop, k8s preemption) drains workers
+    # gracefully: each child's handler raises and reports home instead of
+    # the whole run wedging into a receive timeout.  signal.signal is
+    # main-thread-only; from a dispatcher thread we skip installation — a
+    # process-group SIGTERM still reaches the children directly, where
+    # their own handlers take over.
+    import signal
+    import threading as _threading
+    _prev_sigterm = None
+    _sigterm_installed = False
+
+    def _forward_sigterm(signum, frame):
+        for pr in procs:
+            if pr.is_alive() and pr.pid:
+                try:
+                    os.kill(pr.pid, signal.SIGTERM)
+                except ProcessLookupError:  # pragma: no cover — just exited
+                    pass
+        if callable(_prev_sigterm):
+            _prev_sigterm(signum, frame)
+
+    if _threading.current_thread() is _threading.main_thread():
+        try:
+            _prev_sigterm = signal.signal(signal.SIGTERM, _forward_sigterm)
+            _sigterm_installed = True
+        except (ValueError, OSError):  # pragma: no cover — exotic platform
+            pass
 
     import queue as queue_mod
     results: dict[int, Any] = {}
@@ -263,6 +310,16 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
             else:
                 errors[rank] = payload
     finally:
+        if _sigterm_installed:
+            try:
+                # a None previous disposition (handler installed by
+                # non-Python code) cannot be re-installed; fall back to
+                # SIG_DFL rather than abort the finally's child cleanup
+                signal.signal(signal.SIGTERM,
+                              _prev_sigterm if _prev_sigterm is not None
+                              else signal.SIG_DFL)
+            except (ValueError, OSError, TypeError):  # pragma: no cover
+                pass
         # drain BEFORE joining: a child whose feeder is mid-write into a
         # dead peer's full pipe can only finish (and exit) once the parent
         # consumes that pipe; terminating it instead would truncate the

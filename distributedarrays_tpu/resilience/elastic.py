@@ -13,10 +13,15 @@ Semantics:
 - :func:`manager` — the process-wide :class:`ElasticDeviceSet`.
 - ``mark_down`` / ``mark_up`` — explicit health edits (a real deployment
   wires these to its platform's health signal).
-- ``probe()`` — one health epoch: merges the manual marks with the fault
-  harness's simulated-down set (``faults.probe_tick`` — which is also
-  where simulated devices revive), updates the ``elastic.live_devices``
-  gauge, and journals transitions.
+- ``probe()`` — one health epoch: reads the REAL device signals
+  (``jax.devices()`` enumeration liveness, an optional active per-device
+  ping under ``DA_TPU_ELASTIC_ACTIVE_PROBE=1``, and the multihost peer
+  heartbeat from ``parallel.multihost``), merges them with the manual
+  marks and the fault harness's simulated-down set (``faults.probe_tick``
+  — which is also where simulated devices revive, and the deterministic
+  fallback chaos tests drive), updates the ``elastic.live_devices``
+  gauge, and journals transitions.  ``DA_TPU_ELASTIC_HW_PROBE=0``
+  disables the real-signal half entirely.
 - ``shrink()`` — re-lay-out every registered DArray that touches a down
   rank onto the survivors.  Data movement is ``parallel.reshard`` with a
   device-set-changing plan (the planner's ``device_put`` fallback — the
@@ -39,10 +44,13 @@ the freshly restored arrays.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 import numpy as np
+
+import jax
 
 from .. import core
 from .. import layout as L
@@ -121,6 +129,11 @@ class ElasticDeviceSet:
         self._lock = threading.RLock()
         self._manual_down: dict[int, float] = {}    # rank -> since (mono)
         self._sim_down: set[int] = set()
+        self._hw_down: set[int] = set()             # REAL-signal probe
+        # the rank set as last successfully enumerated: when the runtime
+        # itself becomes unreachable (jax.devices() raising), health math
+        # must still work — against this snapshot, with every rank down
+        self._expected: list[int] | None = None
         # array ids shrink() re-laid-out — the ONLY ids grow() touches:
         # an array the failure never displaced keeps whatever layout its
         # owner chose (growing everything would destroy deliberate
@@ -129,16 +142,29 @@ class ElasticDeviceSet:
 
     # -- health ------------------------------------------------------------
 
+    def _expected_ranks(self) -> list[int]:
+        # the snapshot only GROWS: a shrunken enumeration must not shrink
+        # the baseline, or the vanished trailing ranks would read as
+        # "never existed" instead of "down" on every subsequent epoch
+        try:
+            ranks = L.all_ranks()
+            if self._expected is None or len(ranks) > len(self._expected):
+                self._expected = ranks
+        except Exception:
+            pass
+        return list(self._expected or [])
+
     def all_ranks(self) -> list[int]:
-        return L.all_ranks()
+        return self._expected_ranks()
 
     def down_ranks(self) -> set[int]:
         with self._lock:
-            return set(self._manual_down) | set(self._sim_down)
+            return (set(self._manual_down) | set(self._sim_down)
+                    | set(self._hw_down))
 
     def live_ranks(self) -> list[int]:
         down = self.down_ranks()
-        return [r for r in L.all_ranks() if r not in down]
+        return [r for r in self._expected_ranks() if r not in down]
 
     def mark_down(self, rank: int, reason: str = "manual") -> None:
         with self._lock:
@@ -155,31 +181,84 @@ class ElasticDeviceSet:
     def mark_up(self, rank: int) -> None:
         # also revives a plan-downed device whose spec had no
         # revive_after countdown (down-until-mark_up semantics); the
-        # next probe() epoch re-merges the shrunken simulated set
+        # next probe() epoch re-merges the shrunken simulated set.
+        # The hw mark clears too — mark_up is the operator override, and
+        # a still-dead device simply re-enters _hw_down on the next probe
         faults.revive(int(rank))
         with self._lock:
             self._sim_down.discard(int(rank))
+            self._hw_down.discard(int(rank))
             was = self._manual_down.pop(int(rank), None)
         if was is not None and _tm.enabled():
             # cold path: a device transition is an exceptional event
             _tm.event("elastic", "up", rank=int(rank))  # dalint: disable=DAL003
         self._update_gauge()
 
+    def _hw_probe(self) -> set[int]:
+        """One REAL-signal health reading: device-runtime liveness via
+        ``jax.devices()`` enumeration (runtime unreachable ⇒ every
+        expected rank down; a shrunken enumeration downs the vanished
+        trailing ranks), an optional per-device active ping
+        (``DA_TPU_ELASTIC_ACTIVE_PROBE=1`` — a 1-element put round-trip,
+        too slow for every epoch by default), and the multihost peer
+        heartbeat (a stale controller downs its ranks).  Disable the
+        whole real-signal half with ``DA_TPU_ELASTIC_HW_PROBE=0`` — the
+        fault harness's simulated-down set (merged separately in
+        :meth:`probe`) is the deterministic-test fallback either way."""
+        if os.environ.get("DA_TPU_ELASTIC_HW_PROBE", "1") == "0":
+            return set()
+        expected = list(self._expected or [])
+        try:
+            devs = jax.devices()
+        except Exception:
+            # the device runtime itself is unreachable: every rank we
+            # ever knew about is down (the manager's cached snapshot is
+            # the only rank set that still exists to report against)
+            return set(expected)
+        if len(devs) > len(expected):
+            # growth (first probe, or a revival) refreshes the baseline;
+            # shrinkage NEVER does — see _expected_ranks
+            self._expected = expected = list(range(len(devs)))
+        down: set[int] = set()
+        if expected and len(devs) < len(expected):
+            down |= set(expected[len(devs):])
+        if os.environ.get("DA_TPU_ELASTIC_ACTIVE_PROBE") == "1":
+            for i, dev in enumerate(devs):  # pragma: no cover — opt-in
+                try:
+                    jax.device_put(np.zeros(1), dev).block_until_ready()
+                except Exception:
+                    down.add(i)
+        try:
+            from ..parallel import multihost as _mh
+            _mh.heartbeat()
+            stale = _mh.down_peer_processes()
+            if stale:  # pragma: no cover — needs real multi-host
+                for i, dev in enumerate(devs):
+                    if getattr(dev, "process_index", 0) in stale:
+                        down.add(i)
+        except Exception:  # pragma: no cover — heartbeat must not kill probes
+            pass
+        return down
+
     def probe(self) -> dict:
-        """One health epoch: advance the fault harness's revive clocks,
-        merge its simulated-down set with the manual marks, and report
+        """One health epoch: read the REAL device signals
+        (:meth:`_hw_probe`), advance the fault harness's revive clocks
+        and merge its simulated-down set (the deterministic-test
+        fallback) with the manual marks, and report
         ``{"live": [...], "down": [...], "changed": bool}``."""
+        hw = self._hw_probe()
         sim = faults.probe_tick()
         with self._lock:
-            changed = sim != self._sim_down
+            changed = sim != self._sim_down or hw != self._hw_down
             self._sim_down = set(int(r) for r in sim)
+            self._hw_down = set(int(r) for r in hw)
         self._update_gauge()
         live, down = self.live_ranks(), sorted(self.down_ranks())
         _tm.count("elastic.probes")
         if changed and _tm.enabled():
             # cold path: only journaled on a health transition
             _tm.event("elastic", "probe", live=len(live),  # dalint: disable=DAL003
-                      down=down)
+                      down=down, hw=sorted(hw), sim=sorted(sim))
         return {"live": live, "down": down, "changed": changed}
 
     def _update_gauge(self) -> None:
@@ -263,7 +342,9 @@ class ElasticDeviceSet:
         with self._lock:
             self._manual_down.clear()
             self._sim_down.clear()
+            self._hw_down.clear()
             self._shrunk.clear()
+            self._expected = None      # re-snapshot on the next probe
         self._update_gauge()
 
 

@@ -152,7 +152,7 @@ def fresh_mesh() -> None:
 
 def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                       checkpoints=None, restore_fn=None, devices=None,
-                      **kwargs):
+                      stop_event=None, **kwargs):
     """Run ``fn(*args, **kwargs)`` under the retry discipline.
 
     ``checkpoints``: a ``CheckpointManager`` to restore the latest
@@ -160,6 +160,13 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
     restored tree (re-seat your model/arrays there).  ``devices``: the
     elastic set to probe/shrink on device loss (default:
     ``elastic.manager()``).
+
+    ``stop_event``: an optional ``threading.Event`` that makes the retry
+    loop *interruptible* — backoff sleeps wait on it instead of
+    ``time.sleep``, so a draining server (which sets the event) never
+    blocks on a sleeping retry.  Once set, no further retry is attempted:
+    the pending failure re-raises immediately (typed by the caller), and
+    ``recovery.interrupted`` counts the abort.
     """
     pol = policy or RetryPolicy()
     devs = devices if devices is not None else elastic.manager()
@@ -188,11 +195,15 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             verdict = _bundle_verdict(e, _tm.flight.last_bundle(), fresh)
             _tm.count("recovery.failures", verdict=verdict)
             retries_used = attempt - 1
-            retryable = (verdict != "divergence"
+            interrupted = stop_event is not None and stop_event.is_set()
+            retryable = (not interrupted
+                         and verdict != "divergence"
                          and retries_used < pol.max_retries
                          and not (verdict == "timeout"
                                   and timeout_retries
                                   >= pol.timeout_retries))
+            if interrupted:
+                _tm.count("recovery.interrupted", verdict=verdict)
             if _tm.enabled():
                 # cold path: one event per failed attempt
                 _tm.event("recovery", "failure", verdict=verdict,  # dalint: disable=DAL003
@@ -227,7 +238,17 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                 # shrink AFTER the restore so freshly restored arrays
                 # land on survivors too
                 devs.shrink()
-            time.sleep(pol.delay(retries_used))
+            # interruptible backoff: a drain/shutdown signal wakes the
+            # sleep promptly and abandons the retry with the pending
+            # failure — a draining server must never sit out an
+            # exponential delay before it can finish
+            delay = pol.delay(retries_used)
+            if stop_event is None:
+                time.sleep(delay)
+            elif stop_event.wait(delay):
+                _tm.count("recovery.interrupted", verdict=verdict)
+                _tm.count("recovery.giveups", verdict=verdict)
+                raise
             _tm.count("recovery.retries", verdict=verdict)
             continue
         if attempt > 1:
@@ -239,7 +260,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
 
 
 def resilient(*, policy: RetryPolicy | None = None, checkpoints=None,
-              restore_fn=None, devices=None):
+              restore_fn=None, devices=None, stop_event=None):
     """Decorator form of :func:`run_with_recovery`::
 
         @resilient(checkpoints=mgr, restore_fn=reseat)
@@ -250,6 +271,7 @@ def resilient(*, policy: RetryPolicy | None = None, checkpoints=None,
         def wrapped(*args, **kwargs):
             return run_with_recovery(
                 fn, *args, policy=policy, checkpoints=checkpoints,
-                restore_fn=restore_fn, devices=devices, **kwargs)
+                restore_fn=restore_fn, devices=devices,
+                stop_event=stop_event, **kwargs)
         return wrapped
     return deco

@@ -1,0 +1,29 @@
+"""Multi-tenant async serving layer over resident sharded model state.
+
+Quick use::
+
+    from distributedarrays_tpu import serve
+
+    srv = serve.Server(serve.ServeConfig(max_batch=8, max_queue=64))
+    srv.register("score", lambda xs: [score_one(x) for x in xs])
+    fut = srv.submit("score", x, tenant="team-a", deadline_s=0.5)
+    y = fut.result()          # result, or a typed ServeError
+    srv.close()               # graceful: stop admitting, flush, stop
+
+Architecture, admission/shedding policy knobs, deadline semantics, and a
+worked overload walkthrough: docs/serving.md.
+"""
+
+from .admission import AdmissionController, LatencyWindow, TokenBucket
+from .batching import BatchQueue, Request, payload_key
+from .errors import (DeadlineExceeded, Draining, Overloaded, QuotaExceeded,
+                     Rejected, RequestFailed, ServeError)
+from .server import Endpoint, ServeConfig, Server, install_sigterm
+
+__all__ = [
+    "Server", "ServeConfig", "Endpoint", "install_sigterm",
+    "AdmissionController", "LatencyWindow", "TokenBucket",
+    "BatchQueue", "Request", "payload_key",
+    "ServeError", "Rejected", "Overloaded", "QuotaExceeded", "Draining",
+    "DeadlineExceeded", "RequestFailed",
+]

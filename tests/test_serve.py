@@ -1,0 +1,770 @@
+"""Serving-layer suite: admission, continuous batching, deadlines,
+backpressure shedding, graceful drain/SIGTERM, async dispatch, real
+elastic health probes — and the chaos leg (a seeded fault plan kills a
+device mid-batch; every in-flight request must resolve to a correct
+result or a typed error, never a silent hang, with the per-test
+registry/HBM-ledger leak gate draining afterwards).
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import distributedarrays_tpu as dat
+from distributedarrays_tpu import serve, telemetry as tm
+from distributedarrays_tpu.parallel import multihost, spmd_mode as S
+from distributedarrays_tpu.resilience import elastic, faults, recovery
+from distributedarrays_tpu.serve import (DeadlineExceeded, Draining,
+                                         Overloaded, QuotaExceeded,
+                                         RequestFailed, ServeError)
+from distributedarrays_tpu.telemetry import flight
+from distributedarrays_tpu.telemetry import memory as tmem
+
+_HAS_FORK = hasattr(os, "fork")
+process_only = pytest.mark.skipif(not _HAS_FORK, reason="needs POSIX fork")
+
+
+@pytest.fixture(autouse=True)
+def _clean_serving():
+    """Process-wide singletons (fault plan, elastic manager, flight
+    recorder) start and end pristine, like the resilience suite."""
+    faults.clear()
+    elastic.manager().reset()
+    flight._reset()
+    yield
+    faults.clear()
+    elastic.manager().reset()
+    flight._reset()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("base_delay", 0.005)
+    kw.setdefault("max_delay", 0.02)
+    return recovery.RetryPolicy(**kw)
+
+
+def _cfg(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("flush_s", 0.005)
+    kw.setdefault("max_queue", 32)
+    kw.setdefault("tenant_rate", 10_000.0)
+    kw.setdefault("tenant_burst", 10_000.0)
+    return serve.ServeConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# basic request/future flow + continuous batching
+# ---------------------------------------------------------------------------
+
+
+def test_submit_resolves_results_in_order():
+    with serve.Server(_cfg()) as srv:
+        srv.register("double", lambda xs: [x * 2 for x in xs])
+        futs = [srv.submit("double", np.full((3,), i)) for i in range(12)]
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(timeout=10),
+                                          np.full((3,), 2 * i))
+
+
+def test_requests_coalesce_into_batches():
+    sizes = []
+
+    def ep(xs):
+        sizes.append(len(xs))
+        time.sleep(0.003)          # let the queue build a real batch
+        return list(xs)
+
+    with serve.Server(_cfg(max_batch=4, flush_s=0.05)) as srv:
+        srv.register("echo", ep)
+        futs = [srv.submit("echo", np.zeros(2)) for _ in range(10)]
+        for f in futs:
+            f.result(timeout=10)
+    assert sum(sizes) == 10
+    assert max(sizes) > 1, f"no coalescing happened: {sizes}"
+    assert max(sizes) <= 4, f"batch cap violated: {sizes}"
+
+
+def test_incompatible_shapes_never_share_a_batch():
+    keys = []
+
+    def ep(xs):
+        keys.append({x.shape for x in xs})
+        return [x.sum() for x in xs]
+
+    with serve.Server(_cfg(flush_s=0.02)) as srv:
+        srv.register("sum", ep)
+        futs = [srv.submit("sum", np.ones((2,)) if i % 2 else np.ones((3,)))
+                for i in range(8)]
+        for f in futs:
+            f.result(timeout=10)
+    for seen in keys:
+        assert len(seen) == 1, f"mixed-shape batch dispatched: {keys}"
+
+
+def test_payload_key_signatures():
+    k = serve.payload_key
+    assert k(np.zeros((2, 3))) == k(np.ones((2, 3)))
+    assert k(np.zeros((2, 3))) != k(np.zeros((3, 2)))
+    assert k(np.zeros(2, np.float32)) != k(np.zeros(2, np.float64))
+    assert k({"a": np.zeros(2), "b": 1}) == k({"b": 2, "a": np.ones(2)})
+    assert k((1, "x")) == k((2, "y"))
+    assert k([1]) != k((1,))
+    # mixed-type dict keys are a legal payload, not an untyped TypeError
+    assert k({1: "a", "b": 2}) == k({"b": 3, 1: "c"})
+
+
+def test_per_endpoint_batch_limits_honored_with_multiple_endpoints():
+    sizes = {"bulk": [], "small": []}
+
+    def make(name):
+        def ep(xs):
+            sizes[name].append(len(xs))
+            time.sleep(0.002)
+            return list(xs)
+        return ep
+
+    # bulk's max_batch EXCEEDS the config default: its own bound, not
+    # the config cap, must govern its batches
+    with serve.Server(_cfg(max_batch=2, flush_s=0.05)) as srv:
+        srv.register("bulk", make("bulk"), max_batch=6)
+        srv.register("small", make("small"), max_batch=2)
+        futs = [srv.submit("bulk", np.zeros(1)) for _ in range(12)]
+        futs += [srv.submit("small", np.zeros(1)) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=10)
+    assert max(sizes["bulk"]) > 2, f"bulk capped at config: {sizes}"
+    assert max(sizes["bulk"]) <= 6
+    assert max(sizes["small"]) <= 2
+
+
+def test_unknown_endpoint_is_typed():
+    with serve.Server(_cfg()) as srv:
+        srv.register("known", lambda xs: xs)
+        with pytest.raises(ServeError, match="unknown endpoint"):
+            srv.submit("nope", 1)
+
+
+def test_endpoint_result_count_contract():
+    with serve.Server(_cfg(max_batch=1)) as srv:
+        srv.register("bad", lambda xs: [])        # wrong arity
+        fut = srv.submit("bad", np.zeros(1))
+        with pytest.raises(RequestFailed, match="returned 0 results"):
+            fut.result(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation: enqueue, batch formation, dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_dead_on_arrival_rejected_at_enqueue():
+    with serve.Server(_cfg()) as srv:
+        srv.register("echo", lambda xs: xs)
+        with pytest.raises(DeadlineExceeded) as ei:
+            srv.submit("echo", 1, deadline_s=0.0)
+        assert ei.value.stage == "enqueue"
+
+
+def test_expired_queued_request_never_dispatched():
+    block = threading.Event()
+    seen = []
+
+    def ep(xs):
+        seen.extend(xs)
+        block.wait(10)
+        return list(xs)
+
+    srv = serve.Server(_cfg(max_batch=1, flush_s=0.0))
+    try:
+        srv.register("slow", ep)
+        f1 = srv.submit("slow", "first")
+        for _ in range(200):            # wait until the worker is stuck
+            if seen:
+                break
+            time.sleep(0.005)
+        assert seen == ["first"]
+        f2 = srv.submit("slow", "second", deadline_s=0.05)
+        time.sleep(0.15)                # budget expires while queued
+        block.set()
+        assert f1.result(timeout=10) == "first"
+        with pytest.raises(DeadlineExceeded) as ei:
+            f2.result(timeout=10)
+        assert ei.value.stage in ("batch", "dispatch")
+        assert seen == ["first"], "expired request was dispatched"
+    finally:
+        block.set()
+        srv.close()
+    assert tm.counter_value("serve.expired", stage=ei.value.stage) >= 1
+
+
+# ---------------------------------------------------------------------------
+# admission control: quotas, queue bound, backpressure signals
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_refills_at_rate():
+    b = serve.TokenBucket(rate=100.0, burst=2.0)
+    assert b.try_take() == 0.0
+    assert b.try_take() == 0.0
+    wait = b.try_take()
+    assert 0.0 < wait <= 0.01 + 1e-3
+    time.sleep(wait + 0.005)
+    assert b.try_take() == 0.0
+
+
+def test_latency_window_percentiles_roll():
+    w = serve.LatencyWindow(maxlen=4)
+    for v in (1.0, 1.0, 1.0, 1.0):
+        w.record(v)
+    assert w.p99() == 1.0
+    for v in (0.1, 0.1, 0.1, 0.1):   # old samples roll out entirely
+        w.record(v)
+    assert w.p99() == pytest.approx(0.1)
+    assert w.p50() == pytest.approx(0.1)
+
+
+def test_tenant_quota_rejects_typed_and_isolated():
+    with serve.Server(_cfg()) as srv:
+        srv.register("echo", lambda xs: xs)
+        srv.set_quota("greedy", rate=5.0, burst=1.0)
+        assert srv.submit("echo", 1, tenant="greedy").result(timeout=10) == 1
+        with pytest.raises(QuotaExceeded) as ei:
+            srv.submit("echo", 2, tenant="greedy")
+        assert ei.value.retry_after > 0
+        assert ei.value.reason == "quota"
+        assert ei.value.tenant == "greedy"
+        # another tenant is untouched by greedy's empty bucket
+        assert srv.submit("echo", 3, tenant="polite").result(timeout=10) == 3
+    assert tm.counter_value("serve.shed", reason="quota",
+                            tenant="greedy") >= 1
+
+
+def test_bounded_queue_sheds_overloaded_with_retry_after():
+    block = threading.Event()
+
+    def ep(xs):
+        block.wait(10)
+        return list(xs)
+
+    srv = serve.Server(_cfg(max_batch=1, flush_s=0.0, max_queue=4))
+    try:
+        srv.register("slow", ep)
+        futs, rejections = [], []
+        for i in range(12):
+            try:
+                futs.append(srv.submit("slow", i))
+            except Overloaded as e:
+                rejections.append(e)
+        assert rejections, "queue bound never shed"
+        for e in rejections:
+            assert e.retry_after > 0
+            assert e.reason == "queue"
+        assert srv.stats()["queue_depth"] <= 4
+        block.set()
+        for f in futs:
+            f.result(timeout=10)       # every admitted request resolves
+    finally:
+        block.set()
+        srv.close()
+
+
+def test_hbm_backpressure_sheds(rng):
+    d = dat.distribute(rng.standard_normal((16, 16)))
+    try:
+        assert tmem.live_bytes() > 0
+        with serve.Server(_cfg(hbm_budget_bytes=1)) as srv:
+            srv.register("echo", lambda xs: xs)
+            with pytest.raises(Overloaded) as ei:
+                srv.submit("echo", 1)
+            assert ei.value.reason == "hbm"
+            assert ei.value.retry_after > 0
+    finally:
+        dat.close(d)
+
+
+def test_rolling_p99_sheds():
+    ctl = serve.AdmissionController(
+        max_queue=64, tenant_rate=1e6, tenant_burst=1e6,
+        p99_shed_s=0.05, max_batch=4)
+    for _ in range(16):
+        ctl.latency.record(0.2)        # dispatch latencies over threshold
+    with pytest.raises(Overloaded) as ei:
+        ctl.admit("t", queue_depth=1)
+    assert ei.value.reason == "latency"
+    assert ei.value.retry_after > 0
+
+
+# ---------------------------------------------------------------------------
+# the open-loop overload acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_open_loop_overload_bounded_and_typed():
+    """At ~2x sustainable offered load: queue depth and HBM live bytes
+    stay bounded, excess requests shed typed with retry_after, and the
+    p99 of ADMITTED requests stays within 2x the unloaded p99 (with a
+    small absolute floor against timer noise on a loaded CI box)."""
+    service_s = 0.004
+
+    def ep(xs):
+        time.sleep(service_s)
+        return [x + 1 for x in xs]
+
+    cfg = _cfg(max_batch=4, flush_s=0.002, max_queue=8)
+    hbm_before = tmem.live_bytes()
+    with serve.Server(cfg) as srv:
+        srv.register("work", ep)
+        # unloaded baseline: sequential round-trips
+        unloaded = []
+        for i in range(20):
+            t0 = time.monotonic()
+            assert srv.submit("work", i).result(timeout=10) == i + 1
+            unloaded.append(time.monotonic() - t0)
+        p99_unloaded = sorted(unloaded)[-1]
+        # open loop at ~2x sustainable (sustainable ~ max_batch/service)
+        sustainable = cfg.max_batch / service_s
+        interval = 1.0 / (2.0 * sustainable)
+        futs, sheds, depths = [], [], []
+        latencies, lat_lock = [], threading.Lock()
+
+        def _mark(t0):
+            def cb(_f):
+                dt = time.monotonic() - t0
+                with lat_lock:
+                    latencies.append(dt)
+            return cb
+
+        t_end = time.monotonic() + 0.8
+        while time.monotonic() < t_end:
+            try:
+                t0 = time.monotonic()
+                f = srv.submit("work", 0)
+                f.add_done_callback(_mark(t0))   # submit→resolve latency
+                futs.append(f)
+            except Overloaded as e:
+                sheds.append(e)
+            depths.append(srv.stats()["queue_depth"])
+            time.sleep(interval)
+        for f in futs:
+            assert f.result(timeout=10) == 1
+        assert sheds, "2x offered load never shed"
+        assert all(e.retry_after > 0 for e in sheds)
+        assert max(depths) <= cfg.max_queue, "queue depth unbounded"
+        assert tmem.live_bytes() == hbm_before, "HBM live bytes grew"
+        # admitted requests kept their latency SLO: every future already
+        # resolved or resolves promptly — the tail is bounded by the
+        # queue bound, not by the offered load
+        admitted_p99 = sorted(latencies)[-1] if latencies else 0.0
+        floor = 0.05
+        assert admitted_p99 <= 2.0 * max(p99_unloaded, floor), (
+            f"admitted p99 {admitted_p99:.4f}s vs unloaded "
+            f"{p99_unloaded:.4f}s")
+    assert tm.counter_value("serve.shed", reason="queue",
+                            tenant="default") >= len(sheds)
+
+
+# ---------------------------------------------------------------------------
+# graceful drain / shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_drain_flushes_queue_then_rejects_typed():
+    def ep(xs):
+        time.sleep(0.01)
+        return list(xs)
+
+    srv = serve.Server(_cfg(max_batch=2, flush_s=0.0))
+    srv.register("work", ep)
+    futs = [srv.submit("work", i) for i in range(6)]
+    assert srv.drain(timeout=10)
+    with pytest.raises(Draining):
+        srv.submit("work", 99)
+    for i, f in enumerate(futs):       # queued work flushed, not dropped
+        assert f.result(timeout=10) == i
+    srv.close()
+    assert tm.counter_value("serve.shed", reason="draining",
+                            tenant="default") >= 1
+
+
+def test_drain_wakes_sleeping_retry_backoff():
+    def ep(xs):
+        raise ValueError("always transient")
+
+    # pathological backoff: without the interruptible sleep the drain
+    # would sit out ~30s; with it the server finishes in well under 5
+    srv = serve.Server(_cfg(max_batch=1, flush_s=0.0),
+                       policy=recovery.RetryPolicy(base_delay=30.0,
+                                                   max_delay=30.0))
+    srv.register("fail", ep)
+    fut = srv.submit("fail", 1)
+    for _ in range(400):               # wait for the first failed attempt
+        if tm.counter_value("recovery.attempts") >= 1 and \
+                srv.stats()["inflight"] >= 1:
+            break
+        time.sleep(0.005)
+    t0 = time.monotonic()
+    assert srv.drain(timeout=10)
+    assert time.monotonic() - t0 < 5.0, "drain blocked on a sleeping retry"
+    with pytest.raises(RequestFailed) as ei:
+        fut.result(timeout=10)
+    assert isinstance(ei.value.__cause__, ValueError)
+    srv.close()
+    assert tm.counter_value("recovery.interrupted", verdict="transient") >= 1
+
+
+def test_close_without_drain_fails_queued_typed():
+    block = threading.Event()
+
+    def ep(xs):
+        block.wait(10)
+        return list(xs)
+
+    srv = serve.Server(_cfg(max_batch=1, flush_s=0.0))
+    srv.register("stuck", ep)
+    f1 = srv.submit("stuck", "inflight")
+    time.sleep(0.05)                   # let the worker pick up f1
+    f2 = srv.submit("stuck", "queued")
+    srv.close(drain=True, timeout=0.2)
+    with pytest.raises(Draining):
+        f2.result(timeout=10)          # typed, never a hang
+    block.set()
+    assert f1.result(timeout=10) == "inflight"
+
+
+def test_close_with_closeall_releases_arrays(rng):
+    d = dat.distribute(rng.standard_normal((8, 8)))
+    srv = serve.Server(_cfg())
+    srv.register("echo", lambda xs: xs)
+    assert srv.submit("echo", 5).result(timeout=10) == 5
+    srv.close(closeall=True)
+    assert dat.live_ids() == []
+    assert d._closed
+
+
+def test_run_with_recovery_stop_event_pre_set():
+    ev = threading.Event()
+    ev.set()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("boom")
+
+    t0 = time.monotonic()
+    with pytest.raises(ValueError):
+        recovery.run_with_recovery(fn, policy=_fast_policy(max_retries=5),
+                                   stop_event=ev)
+    assert len(calls) == 1, "stop_event set must prevent every retry"
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_install_sigterm_drains_and_chains():
+    chained = []
+    srv = serve.Server(_cfg())
+    srv.register("echo", lambda xs: xs)
+    assert srv.submit("echo", 1).result(timeout=10) == 1
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        # a benign callable prior disposition: the handler must drain
+        # FIRST, then chain it (SIG_DFL would instead be re-delivered,
+        # which would terminate this test process — covered by reading
+        # the handler's code path, not by delivering it here)
+        signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+        assert serve.install_sigterm(srv, closeall=False)
+        handler = signal.getsignal(signal.SIGTERM)
+        handler(signal.SIGTERM, None)          # simulate delivery
+        assert srv.stats()["closed"]
+        assert chained == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    with pytest.raises(Draining):
+        srv.submit("echo", 2)
+
+
+# ---------------------------------------------------------------------------
+# async SPMD dispatch (the refactored fan-out)
+# ---------------------------------------------------------------------------
+
+
+def test_spmd_async_matches_blocking_results():
+    fut = S.spmd_async(lambda: S.myid() * 3)
+    assert fut.result(timeout=30) == [r * 3 for r in range(dat.nranks())]
+
+
+def test_spmd_async_runs_overlap():
+    def step():
+        time.sleep(0.1)
+        return S.myid()
+
+    t0 = time.monotonic()
+    f1, f2 = S.spmd_async(step), S.spmd_async(step)
+    r1, r2 = f1.result(timeout=30), f2.result(timeout=30)
+    elapsed = time.monotonic() - t0
+    assert r1 == r2 == list(range(dat.nranks()))
+    assert elapsed < 0.19, f"async runs serialized ({elapsed:.3f}s)"
+
+
+def test_spmd_async_propagates_typed_failure():
+    def boom():
+        if S.myid() == 1:
+            raise ValueError("rank 1 exploded")
+        return S.myid()
+
+    fut = S.spmd_async(boom)
+    with pytest.raises(RuntimeError, match="rank 1"):
+        fut.result(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# process-backend graceful shutdown (SIGTERM forwarding)
+# ---------------------------------------------------------------------------
+
+
+def _pidfile_then_sleep(tmp: str):
+    rank = S.myid()
+    with open(os.path.join(tmp, f"{rank}.pid"), "w") as fh:
+        fh.write(str(os.getpid()))
+    time.sleep(8 if rank == 1 else 0.05)
+    return rank
+
+
+def _kill_when_written(path, sig, pids):
+    for _ in range(200):
+        if all(os.path.exists(os.path.join(path, f"{r}.pid"))
+               for r in pids):
+            break
+        time.sleep(0.02)
+    time.sleep(0.05)
+    with open(os.path.join(path, "1.pid")) as fh:
+        os.kill(int(fh.read()), sig)
+
+
+@process_only
+def test_process_worker_sigterm_drains_and_reports(tmp_path):
+    # a SIGTERM straight to a worker child must surface as a clear
+    # "received SIGTERM" rank failure, not a cryptic receive timeout
+    killer = threading.Thread(
+        target=_kill_when_written,
+        args=(str(tmp_path), signal.SIGTERM, [1]), daemon=True)
+    killer.start()
+    with pytest.raises(RuntimeError, match="received SIGTERM"):
+        S.spmd(_pidfile_then_sleep, str(tmp_path), pids=[0, 1],
+               backend="process", timeout=30)
+
+
+@process_only
+def test_parent_sigterm_forwarded_to_workers(tmp_path):
+    # SIGTERM at the CONTROLLER while a process run is in flight is
+    # forwarded to every child; the run fails loudly with the workers'
+    # graceful reports (previous SIGTERM disposition was SIG_DFL and is
+    # restored by run_spmd_process's finally)
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def killer():
+        for _ in range(200):
+            if all(os.path.exists(os.path.join(str(tmp_path), f"{r}.pid"))
+                   for r in (0, 1)):
+                break
+            time.sleep(0.02)
+        time.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def both_sleep(tmp):
+        rank = S.myid()
+        with open(os.path.join(tmp, f"{rank}.pid"), "w") as fh:
+            fh.write(str(os.getpid()))
+        time.sleep(8)
+        return rank
+
+    threading.Thread(target=killer, daemon=True).start()
+    try:
+        with pytest.raises(RuntimeError, match="received SIGTERM"):
+            S.spmd(both_sleep, str(tmp_path), pids=[0, 1],
+                   backend="process", timeout=30)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# ---------------------------------------------------------------------------
+# elastic health probes on REAL device signals
+# ---------------------------------------------------------------------------
+
+
+def test_probe_reports_all_down_when_runtime_unreachable(monkeypatch):
+    m = elastic.manager()
+    assert m.probe()["down"] == []        # snapshot cached while healthy
+    import jax
+
+    def _dead():
+        raise RuntimeError("device runtime unreachable")
+
+    monkeypatch.setattr(jax, "devices", _dead)
+    res = m.probe()
+    assert res["down"] == list(range(8))
+    assert res["live"] == []
+    monkeypatch.undo()
+    res = m.probe()                       # revives on the next healthy epoch
+    assert res["down"] == []
+    assert len(res["live"]) == 8
+
+
+def test_shrunken_enumeration_downs_vanished_ranks(monkeypatch):
+    m = elastic.manager()
+    assert m.probe()["down"] == []        # baseline snapshot: 8 ranks
+    import jax
+    real = list(jax.devices())
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: real[:6])
+    res = m.probe()
+    assert res["down"] == [6, 7], "vanished trailing ranks not marked down"
+    assert res["live"] == list(range(6))
+    res = m.probe()                       # the mark persists across epochs
+    assert res["down"] == [6, 7]
+    monkeypatch.undo()
+    res = m.probe()                       # full enumeration back: revived
+    assert res["down"] == []
+    assert len(res["live"]) == 8
+
+
+def test_hw_probe_env_kill_switch(monkeypatch):
+    m = elastic.manager()
+    m.probe()
+    import jax
+    monkeypatch.setenv("DA_TPU_ELASTIC_HW_PROBE", "0")
+    monkeypatch.setattr(jax, "devices",
+                        lambda: (_ for _ in ()).throw(RuntimeError("dead")))
+    # real-signal half disabled: the probe trusts the cached snapshot and
+    # the deterministic fault-harness fallback only
+    assert m.probe()["down"] == []
+
+
+def test_probe_merges_sim_down_as_deterministic_fallback():
+    faults.configure(plan=[{"site": "spmd.rank", "match": {"rank": 0},
+                            "action": "device_loss", "device": 2,
+                            "revive_after": 2}], seed=7)
+    with pytest.raises(faults.InjectedDeviceLoss):
+        faults.check("spmd.rank", rank=0, backend="thread")
+    m = elastic.manager()
+    res = m.probe()                        # tick 1: still down
+    assert 2 in res["down"]
+    res = m.probe()                        # tick 2: revives
+    assert res["down"] == []
+
+
+def test_multihost_heartbeat_single_process_degrades():
+    assert multihost.heartbeat() is False
+    assert multihost.down_peer_processes() == set()
+
+
+def test_stale_peer_process_downs_its_ranks(monkeypatch):
+    m = elastic.manager()
+    m.probe()
+    monkeypatch.setattr(multihost, "down_peer_processes",
+                        lambda stale_s=30.0: {0})
+    res = m.probe()
+    # on this harness every virtual device belongs to process 0
+    assert res["down"] == list(range(8))
+
+
+# ---------------------------------------------------------------------------
+# the serving chaos leg
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_device_loss_mid_batch_all_requests_resolve(monkeypatch, rng):
+    """Seeded DA_TPU_FAULT_PLAN kills a device mid-batch: the recovery
+    executor probes, shrinks the resident DArray off the dead rank, and
+    retries; every in-flight request resolves to a correct result or a
+    typed error (zero hangs), shed requests carry retry_after, recovery
+    counters are recorded, and the leak gate (conftest) drains."""
+    plan = [{"site": "serve.dispatch", "action": "device_loss", "at": 2,
+             "count": 1, "device": 3, "revive_after": 3}]
+    monkeypatch.setenv("DA_TPU_FAULT_PLAN", json.dumps(plan))
+    monkeypatch.setenv("DA_TPU_FAULT_SEED", "1234")
+    faults.configure()
+
+    base = rng.standard_normal((8, 8))
+    d = dat.distribute(base)
+    retries0 = tm.counter_value("recovery.retries", verdict="device_loss")
+
+    def ep(xs):
+        resident = dat.gather(d)       # resident sharded state
+        return [float(resident.sum() + np.sum(x)) for x in xs]
+
+    expect_base = float(base.sum())
+    srv = serve.Server(_cfg(max_batch=4, flush_s=0.01),
+                       policy=_fast_policy())
+    try:
+        srv.register("score", ep)
+        # wave 1 (dispatch invocation 1: clean), wave 2 (invocation 2:
+        # the plan kills device 3 mid-batch; recovery shrinks + retries)
+        for wave in range(2):
+            futs = [srv.submit("score", np.full((2,), float(i)))
+                    for i in range(4)]
+            for i, f in enumerate(futs):
+                assert f.result(timeout=30) == pytest.approx(
+                    expect_base + 2.0 * i), f"wave {wave} wrong result"
+        # the shed path still carries retry_after under chaos
+        srv.set_quota("greedy", rate=1.0, burst=1.0)
+        assert srv.submit("score", np.zeros(2),
+                          tenant="greedy").result(timeout=30) == \
+            pytest.approx(expect_base)
+        with pytest.raises(Overloaded) as ei:
+            srv.submit("score", np.zeros(2), tenant="greedy")
+        assert ei.value.retry_after > 0
+        assert srv.drain(timeout=10)
+    finally:
+        srv.close()
+    # the fault really fired, was classified device_loss, and recovery
+    # retried after shrinking the resident array off the dead rank
+    hist = faults.history()
+    assert [h["action"] for h in hist] == ["device_loss"]
+    assert tm.counter_value("recovery.retries",
+                            verdict="device_loss") > retries0
+    assert 3 not in {int(p) for p in d.pids.flat}, \
+        "resident state still touches the dead device"
+    assert tm.counter_value("serve.completed", endpoint="score") >= 9
+    dat.close(d)
+
+
+def test_chaos_unretryable_failure_resolves_typed(monkeypatch):
+    # a failure the verdict table refuses to retry (divergence marker in
+    # the message) must fail the batch typed, never hang the futures
+    plan = [{"site": "serve.dispatch", "action": "raise", "at": 1,
+             "count": -1}]
+    monkeypatch.setenv("DA_TPU_FAULT_PLAN", json.dumps(plan))
+    monkeypatch.setenv("DA_TPU_FAULT_SEED", "7")
+    faults.configure()
+    srv = serve.Server(_cfg(max_batch=2, flush_s=0.0),
+                       policy=_fast_policy(max_retries=1))
+    try:
+        srv.register("echo", lambda xs: xs)
+        futs = [srv.submit("echo", i) for i in range(4)]
+        for f in futs:
+            with pytest.raises(RequestFailed) as ei:
+                f.result(timeout=30)
+            assert isinstance(ei.value.__cause__, faults.InjectedFault)
+    finally:
+        srv.close()
+    assert tm.counter_value("serve.failed", endpoint="echo") >= 4
+
+
+# ---------------------------------------------------------------------------
+# telemetry surface
+# ---------------------------------------------------------------------------
+
+
+def test_serving_metrics_and_spans_recorded():
+    with serve.Server(_cfg()) as srv:
+        srv.register("echo", lambda xs: xs)
+        for i in range(6):
+            assert srv.submit("echo", i).result(timeout=10) == i
+    assert tm.counter_value("serve.admitted", tenant="default") >= 6
+    assert tm.counter_value("serve.batches", endpoint="echo") >= 1
+    assert tm.gauge_value("serve.queue_depth") == 0
+    assert "serve.dispatch" in tm.span_stats()
