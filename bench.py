@@ -33,6 +33,7 @@ any entry above peak is flagged in ``_impossible`` (and would indicate a
 methodology bug, not a fast chip).
 """
 
+import functools
 import json
 import os
 import random
@@ -370,6 +371,7 @@ BANKED_SENTINELS = {
     "stencil_jnp": "stencil_8192_jnp_gcells_per_s",
     "stencil_temporal": "stencil_8192_temporal_s_per_iter",
     "reshard_even": "reshard_even_s",
+    "ring_gemm": "ring_gemm_xla_s",
     "serve_load": "serve_load_p99_s",
     "reshard_uneven": "reshard_uneven_fill_s",
     "reshard_mutate": "reshard_mutate_s",
@@ -1532,14 +1534,24 @@ def main():
 
         once()                             # compile
         t_rs = min(_t(once) for _ in range(3))
+        from distributedarrays_tpu.ops import pallas_collectives as P_
+        rdma = P_.rdma_mode()
         out = {
             "reshard_even_n": NR,
             "reshard_even_nranks": p,
             "reshard_even_strategy": plan.strategy,
             "reshard_even_nchunks": plan.nchunks,
             "reshard_even_plan_moved_mb": plan.moved_bytes / 2**20,
+            "reshard_even_dispatch": rdma or "xla",
             "reshard_even_s": t_rs,
         }
+        if rdma and plan.strategy == "all_to_all":
+            lshape = tuple(s // p if d == plan.src_dim else s
+                           for d, s in enumerate(plan.shape))
+            nc, csrc = P_.a2a_chunks_for(lshape, "float32", p,
+                                         plan.src_dim)
+            out["reshard_even_rdma_chunks"] = nc
+            out["reshard_even_rdma_chunks_source"] = csrc
         if plan.moved_bytes:
             out["reshard_even_gbps"] = plan.moved_bytes / t_rs / 1e9
         # repeated same-pair planning must hit the plan cache
@@ -1622,6 +1634,67 @@ def main():
             d.close()
 
     _guarded(details, "reshard_mutate", cfg_reshard_mutate)
+
+    # ---- extra: ring GEMM, RDMA vs XLA-ppermute paths --------------------
+    # The fused Pallas RDMA collective GEMM (pallas_collectives) against
+    # the lax ring it replaces: same program shape, same operands, the
+    # only delta is who schedules the wire time.  Banks both wall times,
+    # the RDMA path's TFLOPS, and the dispatch that actually ran (on a
+    # non-TPU platform the "rdma" arm resolves to the lax fallback and
+    # the row says so — a no-delta row is evidence, not a failure).
+    def cfg_ring_gemm():
+        from distributedarrays_tpu.ops import pallas_collectives as _pc
+        from distributedarrays_tpu.ops.collective_matmul import \
+            allgather_matmul_rhs
+        from distributedarrays_tpu.parallel.collectives import (run_spmd,
+                                                                spmd_mesh)
+        from jax.sharding import PartitionSpec as _P
+        from distributedarrays_tpu import telemetry as _tmb
+        p = len(devs)
+        NG = 2048
+        mesh = spmd_mesh(p)
+        a = jnp.asarray(np.random.default_rng(21)
+                        .standard_normal((NG, NG)), jnp.bfloat16)
+        b = jnp.asarray(np.random.default_rng(22)
+                        .standard_normal((NG, NG)), jnp.bfloat16)
+        specs = (_P("p", None), _P("p", None))
+        fns = {}
+        for name, arm in (("xla", False), ("rdma", True)):
+            fns[name] = run_spmd(
+                functools.partial(lambda aa, bb, _arm: allgather_matmul_rhs(
+                    aa, bb, "p", rdma=_arm), _arm=arm),
+                mesh, specs, _P("p", None))
+
+        def once(fn):
+            return float(jnp.sum(fn(a, b)[0, :8]))   # scalar fetch = sync
+
+        # the dispatch that ACTUALLY ran: rdma_mode() alone ignores the
+        # kernel-level gates (VMEM budget, dtype) — the trace-time
+        # dispatch counter is ground truth, sampled across the compiles
+        disp0 = _tmb.counter_value("pallas_collectives.dispatch",
+                                   op="ring_allgather_matmul_rhs",
+                                   path="rdma")
+        for fn in fns.values():
+            once(fn)                                 # compile both arms
+        armed = _tmb.counter_value("pallas_collectives.dispatch",
+                                   op="ring_allgather_matmul_rhs",
+                                   path="rdma") > disp0
+        rdma = _pc.rdma_mode()
+        t_xla = min(_t(lambda: once(fns["xla"])) for _ in range(3))
+        t_rdma = min(_t(lambda: once(fns["rdma"])) for _ in range(3))
+        flops = 2.0 * NG * NG * NG
+        return {
+            "ring_gemm_n": NG,
+            "ring_gemm_nranks": p,
+            "ring_gemm_dispatch": (rdma or "xla") if armed else
+                                  ("xla (gated)" if rdma else "xla"),
+            "ring_gemm_xla_s": t_xla,
+            "ring_gemm_rdma_s": t_rdma,
+            "ring_gemm_xla_tflops": flops / t_xla / 1e12,
+            "ring_gemm_rdma_tflops": flops / t_rdma / 1e12,
+        }
+
+    _guarded(details, "ring_gemm", cfg_ring_gemm)
 
     # ---- extra: serving layer under synthetic open-loop load -------------
     # The multi-tenant async executor end to end: a resident sharded

@@ -630,9 +630,13 @@ def _check_dal006(tree, path, lines):
 # DAL007 — direct cross-sharding device_put outside the reshard planner
 # ---------------------------------------------------------------------------
 
-# the one module allowed to call device_put with a sharding target: the
-# planner itself (its device_put fallback IS the planned strategy)
-_RESHARD_HOME = ("parallel/reshard.py", "parallel\\reshard.py")
+# modules allowed to call device_put with a sharding target: the planner
+# itself (its device_put fallback IS the planned strategy) and the Pallas
+# RDMA collective home it lowers through — the PR 8 ring kernels are the
+# planner's own inner exchange, so their staging moves are planned sites,
+# not bypasses
+_RESHARD_HOME = ("parallel/reshard.py", "parallel\\reshard.py",
+                 "ops/pallas_collectives.py", "ops\\pallas_collectives.py")
 
 # second-argument expressions that are clearly NOT layout targets: a bare
 # device / device list moves data without re-laying it out (host staging,

@@ -96,7 +96,7 @@ def _pipeline_jit(mesh):
         T = M + nstg - 1
         perm = [(i, i + 1) for i in range(nstg - 1)]     # no wraparound
 
-        def tick(t, carry):
+        def tick(t, carry, send=True):
             recv, outs = carry
             # stage 0 injects microbatch t (zeros during drain ticks)
             mb_t = lax.dynamic_index_in_dim(
@@ -109,13 +109,16 @@ def _pipeline_jit(mesh):
             cur = lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
             outs = lax.dynamic_update_index_in_dim(
                 outs, jnp.where(valid, y, cur), oidx, 0)
-            # activation advances one stage (non-wrapping shift)
-            recv = lax.ppermute(y, axis, perm)
+            # activation advances one stage (non-wrapping shift); the
+            # final tick's send would be discarded with the loop carry —
+            # skip it instead of paying the wire hop
+            recv = lax.ppermute(y, axis, perm) if send else y
             return recv, outs
 
         recv0 = jnp.zeros((B, H), mb.dtype)
         outs0 = jnp.zeros((M, B, H), mb.dtype)
-        _, outs = lax.fori_loop(0, T, tick, (recv0, outs0))
+        carry = lax.fori_loop(0, T - 1, tick, (recv0, outs0))
+        _, outs = tick(T - 1, carry, send=False)
         # broadcast the last stage's banked outputs to every rank
         src = jnp.where(me == nstg - 1, 1.0, 0.0)
         return lax.psum(outs * src, axis)
@@ -186,7 +189,7 @@ def _train_1f1b_jit(mesh):
         bwd_perm = [(i + 1, i) for i in range(nstg - 1)]
         denom = jnp.asarray(1.0 / (M * B * H), jnp.float32)
 
-        def tick(t, carry):
+        def tick(t, carry, send=True):
             recv_x, recv_g, saved, dW, db, loss_acc = carry
 
             # ---- forward half: stage `me` runs microbatch t - me -------
@@ -228,16 +231,23 @@ def _train_1f1b_jit(mesh):
                 jnp.sum(jnp.square(y2 - tgt_b)) * denom, 0.0)
 
             # ---- ring sends: activation down, cotangent up -------------
-            recv_x = lax.ppermute(
-                jnp.where(f_valid, y, jnp.zeros_like(y)), axis, fwd_perm)
-            recv_g = lax.ppermute(dx, axis, bwd_perm)
+            # (skipped on the final tick — both results would be
+            # discarded with the loop carry, two wasted wire hops)
+            if send:
+                recv_x = lax.ppermute(
+                    jnp.where(f_valid, y, jnp.zeros_like(y)), axis,
+                    fwd_perm)
+                recv_g = lax.ppermute(dx, axis, bwd_perm)
+            else:
+                recv_x, recv_g = y, dx
             return recv_x, recv_g, saved, dW, db, loss_acc
 
         z = jnp.zeros((B, H), mb.dtype)
         init = (z, z, jnp.zeros((S, B, H), mb.dtype),
                 jnp.zeros_like(Ws), jnp.zeros_like(bs),
                 jnp.float32(0.0))
-        _, _, _, dW, db, loss = lax.fori_loop(0, T, tick, init)
+        carry = lax.fori_loop(0, T - 1, tick, init)
+        _, _, _, dW, db, loss = tick(T - 1, carry, send=False)
         # loss lives on the last stage only; grads are per-stage shards
         return dW[None], db[None], lax.psum(loss, axis)
 

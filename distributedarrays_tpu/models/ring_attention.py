@@ -43,6 +43,7 @@ from ..parallel.collectives import (axis_size as _axis_size,
                                     shard_map_compat)
 
 __all__ = ["ring_attention", "ring_attention_kernel",
+           "ring_attention_rdma_kernel",
            "ring_flash_attention", "ring_flash_attention_kernel",
            "zigzag_ring_attention", "zigzag_ring_attention_kernel",
            "zigzag_ring_flash_attention",
@@ -118,16 +119,169 @@ def ring_attention_kernel(q, k, v, axis: str, causal: bool = False,
     return jnp.transpose(out, (1, 0, 2))                     # (b, h, dh)
 
 
+# ---------------------------------------------------------------------------
+# RDMA ring attention: the K/V ring and the blockwise online softmax in
+# ONE Pallas kernel — the next hop's K/V remote copy is STARTED before
+# the resident block's accumulate and WAITED after it, so the einsum
+# work covers the wire time (the overlap the XLA ``ppermute`` schedule
+# can only hint at).  Semaphore/credit protocol shared with
+# ``ops/pallas_collectives`` (see its module docstring).
+# ---------------------------------------------------------------------------
+
+
+def _attn_vmem_bytes(b, h, dh, itemsize, qblk):
+    """Scoped-VMEM estimate for the fused kernel: the q input block
+    (VMEM in_spec) and its f32 scaled copy, the two revolving K/V slot
+    pairs, the (m, l, acc) carries, the per-block score/probability
+    tiles (x3: s, p, and the masked intermediate), and the output
+    block."""
+    return (b * h * dh * itemsize + b * h * dh * 4
+            + 4 * b * h * dh * itemsize + 2 * h * b * 4
+            + h * b * dh * 4 + 3 * h * qblk * b * 4 + b * h * dh * itemsize)
+
+
+@functools.lru_cache(maxsize=64)
+def _rdma_attn_call(axis, p, b, h, dh, dtype_str, causal, scale, qblk,
+                    interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from ..ops import pallas_collectives as _pc
+
+    dtype = jnp.dtype(dtype_str)
+    nq = b // qblk
+    sc = float(1.0 / np.sqrt(dh) if scale is None else scale)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, qf, kv, m_ref, l_ref, acc,
+               send_sem, recv_sem, copy_sem, cbuf, csend, crecv):
+        me = lax.axis_index(axis)
+        left = _pc._mod(me - 1, p)
+        right = _pc._mod(me + 1, p)
+        credit = _pc._Credit(cbuf, csend, crecv)
+        _pc._copy(k_ref, kv.at[0, 0], copy_sem)
+        _pc._copy(v_ref, kv.at[0, 1], copy_sem)
+        # mirror the lax path exactly: scale in the input dtype, then f32
+        qf[...] = (q_ref[...] * jnp.asarray(sc, dtype)).astype(jnp.float32)
+        m_ref[...] = jnp.full((h, b), -jnp.inf, jnp.float32)
+        l_ref[...] = jnp.zeros((h, b), jnp.float32)
+        acc[...] = jnp.zeros((h, b, dh), jnp.float32)
+        for t in range(p):
+            s = t % 2
+            src = _pc._mod(me - t, p)        # resident block's origin
+            if t < p - 1:
+                if t >= 2:
+                    credit.take(right)       # right freed the slot we hit
+                fwd = pltpu.make_async_remote_copy(
+                    src_ref=kv.at[s], dst_ref=kv.at[1 - s],
+                    send_sem=send_sem.at[s], recv_sem=recv_sem.at[1 - s],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                fwd.start()
+            # resident block accumulates while the K/V pair rides the
+            # ring — blocked over query rows to bound the score tile
+            kc = kv[s, 0].astype(jnp.float32)
+            vc = kv[s, 1].astype(jnp.float32)
+            for qb in range(nq):
+                r0 = qb * qblk
+                qx = qf[r0:r0 + qblk]
+                s_ = jnp.einsum("qhd,khd->hqk", qx, kc)
+                if causal:
+                    qpos = me * b + r0 + lax.broadcasted_iota(
+                        jnp.int32, (qblk, b), 0)
+                    kpos = src * b + lax.broadcasted_iota(
+                        jnp.int32, (qblk, b), 1)
+                    s_ = jnp.where((kpos <= qpos)[None], s_, -jnp.inf)
+                mm = m_ref[:, r0:r0 + qblk]
+                blk_max = jnp.max(s_, axis=-1)
+                m_new = jnp.maximum(mm, blk_max)
+                m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                pr = jnp.exp(s_ - m_safe[:, :, None])
+                pr = jnp.where(jnp.isfinite(s_), pr, 0.0)
+                alpha = jnp.where(jnp.isfinite(mm), jnp.exp(mm - m_safe),
+                                  0.0)
+                l_ref[:, r0:r0 + qblk] = (l_ref[:, r0:r0 + qblk] * alpha
+                                          + jnp.sum(pr, axis=-1))
+                acc[:, r0:r0 + qblk] = (
+                    acc[:, r0:r0 + qblk] * alpha[:, :, None]
+                    + jnp.einsum("hqk,khd->hqd", pr, vc))
+                m_ref[:, r0:r0 + qblk] = m_new
+            if t < p - 1:
+                fwd.wait()
+                if 1 <= t <= p - 3:          # balance against the takes
+                    credit.grant(left)
+        ll = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        out = (acc[...] / ll[:, :, None]).astype(dtype)
+        o_ref[...] = jnp.transpose(out, (1, 0, 2))
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((b, h, dh), jnp.float32),
+                        pltpu.VMEM((2, 2, b, h, dh), dtype),
+                        pltpu.VMEM((h, b), jnp.float32),
+                        pltpu.VMEM((h, b), jnp.float32),
+                        pltpu.VMEM((h, b, dh), jnp.float32),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA] + _pc._credit_scratch(),
+        interpret=interpret,
+    )
+
+
+def ring_attention_rdma_kernel(q, k, v, axis: str, causal: bool = False,
+                               scale: float | None = None,
+                               interpret: bool | None = None):
+    """The fused Pallas RDMA path of :func:`ring_attention_kernel` —
+    same contract, K/V ring hops as in-kernel remote DMAs overlapped
+    with the online-softmax accumulates.  Falls back to the ``lax``
+    kernel when RDMA is unavailable (platform, kill switch, K/V dtype
+    mismatch, VMEM budget)."""
+    from ..ops import pallas_collectives as _pc
+
+    p = _axis_size(axis)
+    b, h, dh = (int(s) for s in q.shape)
+    mode = _pc.rdma_mode(interpret)
+    qblk = b // _pc._chunk_fit(b, max(-(-b // 256), 1))
+    if mode == "compiled" and _attn_vmem_bytes(
+            b, h, dh, jnp.dtype(q.dtype).itemsize,
+            qblk) > _pc._VMEM_LIMIT:
+        mode = None
+    if p == 1 or mode is None or k.dtype != q.dtype or v.dtype != q.dtype:
+        return ring_attention_kernel(q, k, v, axis, causal=causal,
+                                     scale=scale)
+    _pc._record_dispatch("ring_attention", "rdma", k, axis, mode=mode)
+    return _rdma_attn_call(axis, p, b, h, dh, str(q.dtype), bool(causal),
+                           None if scale is None else float(scale), qblk,
+                           mode == "interpret")(q, k, v)
+
+
 @functools.lru_cache(maxsize=32)
-def _ring_jit(mesh, causal: bool):
+def _ring_jit(mesh, causal: bool, rdma=None):
     axis = mesh.axis_names[0]
     spec = P(axis, None, None)
 
     def fn(q, k, v):
+        if rdma:
+            return ring_attention_rdma_kernel(
+                q, k, v, axis, causal=causal,
+                interpret=rdma == "interpret")
         return ring_attention_kernel(q, k, v, axis, causal=causal)
 
     return jax.jit(shard_map_compat(fn, mesh=mesh, in_specs=(spec,) * 3,
                                  out_specs=spec, check=False))
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_jit_1d(pids: tuple, causal: bool, rdma: str):
+    # the RDMA kernels address ring neighbors by LOGICAL device id,
+    # which Pallas only supports under a single named mesh axis — so the
+    # armed program runs over the canonical 1-D mesh (same devices, same
+    # order; inputs committed to the (n,1,1) mesh relabel for free)
+    mesh = L.mesh_for(list(pids), (len(pids),))
+    return _ring_jit(mesh, causal, rdma), mesh
 
 
 def ring_attention(q: DArray, k: DArray, v: DArray,
@@ -145,8 +299,24 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
         raise ValueError(
             "ring attention needs the sequence dim sharded evenly over a "
             f"1-D grid; got grid {q.pids.shape} for dims {q.dims}")
-    mesh = L.mesh_for(pids, (n, 1, 1))
-    out = _ring_jit(mesh, causal)(q.garray, k.garray, v.garray)
+    from ..ops import pallas_collectives as _pc
+    rdma = _pc.rdma_mode()
+    out = None
+    if rdma:
+        fn, _ = _ring_jit_1d(tuple(pids), causal, rdma)
+        try:
+            out = fn(q.garray, k.garray, v.garray)
+        except Exception as e:
+            # the RDMA arm must never cost correctness: take the XLA
+            # ring, loudly once per failure signature
+            from ..utils.debug import warn_once
+            warn_once(f"ring_attention:rdma:{type(e).__name__}",
+                      f"ring_attention RDMA path failed "
+                      f"({type(e).__name__}: {e}); falling back to the "
+                      f"XLA ppermute ring")
+    if out is None:
+        out = _ring_jit(L.mesh_for(pids, (n, 1, 1)), causal)(
+            q.garray, k.garray, v.garray)
     return _wrap_global(out, procs=pids, dist=[n, 1, 1])
 
 

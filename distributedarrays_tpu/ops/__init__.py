@@ -1,6 +1,7 @@
 from . import broadcast, conv, fft, linalg, mapreduce, sort, sparse  # noqa: F401
 
-_LAZY = ("pallas_attention", "pallas_gemm", "collective_matmul")
+_LAZY = ("pallas_attention", "pallas_gemm", "pallas_collectives",
+         "pallas_stencil", "collective_matmul")
 
 
 def __getattr__(name):

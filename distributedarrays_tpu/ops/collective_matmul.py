@@ -52,7 +52,8 @@ def _cannon_skew_perms(g: int):
     return perm_a, perm_b
 
 
-def allgather_matmul(x, w, axis: str):
+def allgather_matmul(x, w, axis: str, *, rdma: bool = False,
+                     interpret: bool | None = None):
     """``all_gather(x, axis) @ w`` with the gather pipelined into the GEMM.
 
     ``x``: this rank's ``(m_loc, k)`` row chunk of the gathered operand;
@@ -65,9 +66,20 @@ def allgather_matmul(x, w, axis: str):
     p`` is resident; it multiplies ``w`` while ``pshift`` fetches the
     next chunk from rank ``r + 1`` — compute covers the hop.  p - 1
     hops total (the last resident chunk multiplies outside the loop).
+
+    ``rdma=True`` arms the fused Pallas RDMA ring
+    (``pallas_collectives.ring_allgather_matmul``: next chunk's DMA
+    started before the resident chunk's dot, waited after it) — 1-D
+    meshes, forward-only (no VJP), subject to the VMEM/platform dispatch
+    gate; ineligible calls keep this ``lax`` path.
     """
     p = _axis_size(axis)
     out_dtype = jnp.result_type(x.dtype, w.dtype)
+    if rdma and p > 1:
+        from .pallas_collectives import ring_allgather_matmul
+        out = ring_allgather_matmul(x, w, axis, interpret=interpret)
+        if out is not None:
+            return out
     if p == 1:
         return (x @ w).astype(out_dtype)
     r = lax.axis_index(axis)
@@ -89,7 +101,8 @@ def allgather_matmul(x, w, axis: str):
                                     (src * m_loc, 0))
 
 
-def allgather_matmul_rhs(a, b, axis: str):
+def allgather_matmul_rhs(a, b, axis: str, *, rdma: bool = False,
+                         interpret: bool | None = None):
     """``a @ all_gather(b, axis)`` with the gather pipelined into the GEMM
     — the RIGHT-operand twin of ``allgather_matmul``.
 
@@ -105,10 +118,16 @@ def allgather_matmul_rhs(a, b, axis: str):
 
     Ring schedule: at step t the chunk originally from rank ``(r + t) %
     p`` is resident and contracts against ``a[:, src*k_loc:(src+1)*
-    k_loc]``; p - 1 hops total.
+    k_loc]``; p - 1 hops total.  ``rdma=True`` arms the fused Pallas
+    RDMA ring (see ``allgather_matmul``).
     """
     p = _axis_size(axis)
     out_dtype = jnp.result_type(a.dtype, b.dtype)
+    if rdma and p > 1:
+        from .pallas_collectives import ring_allgather_matmul_rhs
+        out = ring_allgather_matmul_rhs(a, b, axis, interpret=interpret)
+        if out is not None:
+            return out
     if p == 1:
         return (a @ b).astype(out_dtype)
     r = lax.axis_index(axis)
@@ -131,7 +150,8 @@ def allgather_matmul_rhs(a, b, axis: str):
     return acc + part((r + p - 1) % p, cur)
 
 
-def matmul_reducescatter(x, w, axis: str):
+def matmul_reducescatter(x, w, axis: str, *, rdma: bool = False,
+                         interpret: bool | None = None):
     """``reduce_scatter(x @ w, axis)`` with the reduction pipelined into
     the GEMM.
 
@@ -144,14 +164,20 @@ def matmul_reducescatter(x, w, axis: str):
     t, rank r adds its contribution for destination ``(r - 1 - t) % p``
     and forwards.  After p steps every block has collected all p
     contributions and sits on its destination rank; each hop's
-    ``pshift`` overlaps the next block's matmul.
+    ``pshift`` overlaps the next block's matmul.  ``rdma=True`` arms the
+    fused Pallas RDMA ring (see ``allgather_matmul``).
     """
     p = _axis_size(axis)
-    r = lax.axis_index(axis)
     m, _ = x.shape
     if m % p:
         raise ValueError(
             f"rows {m} must be divisible by the axis size {p}")
+    if rdma and p > 1:
+        from .pallas_collectives import ring_matmul_reducescatter
+        out = ring_matmul_reducescatter(x, w, axis, interpret=interpret)
+        if out is not None:
+            return out
+    r = lax.axis_index(axis)
     m_loc = m // p
 
     def block(d):

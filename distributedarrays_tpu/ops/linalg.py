@@ -260,19 +260,29 @@ def _ring_ag_eligible(A: DArray, B, procs, dist):
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_ag_jit(procs, p, out_dtype_str):
+def _ring_ag_jit(procs, p, out_dtype_str, rdma=None):
     """One shard_map program for the contraction-sharded-B GEMM: ring
-    all-gather of B pipelined into the per-chunk matmuls."""
+    all-gather of B pipelined into the per-chunk matmuls.  The mesh here
+    is the canonical 1-D mesh and this is a forward-only inference path,
+    so the fused Pallas RDMA ring is armed (``rdma`` carries the
+    ``rdma_mode()`` decision into the cache key; ineligible shapes keep
+    the ``lax`` ring via the kernel's own dispatch gate)."""
     from .collective_matmul import allgather_matmul_rhs
     mesh = L.mesh_for(procs, (p,))
     ax = mesh.axis_names[0]
 
     def prog(a, b):
-        return allgather_matmul_rhs(a, b, ax).astype(out_dtype_str)
+        return allgather_matmul_rhs(
+            a, b, ax, rdma=bool(rdma),
+            interpret=(rdma == "interpret") if rdma else None,
+        ).astype(out_dtype_str)
 
+    # pallas_call has no shard_map replication rule: the RDMA variant
+    # must opt out of the check (the XLA variant keeps the default)
     shm = shard_map_compat(prog, mesh=mesh,
                         in_specs=(P(ax, None), P(ax, None)),
-                        out_specs=P(ax, None))
+                        out_specs=P(ax, None),
+                        check=False if rdma else None)
     return mesh, ax, jax.jit(shm)
 
 
@@ -281,14 +291,32 @@ def _ring_ag_gemm(A: DArray, B: DArray, out_dtype):
     the (p,1)-row-sharded result array."""
     p = A.pids.shape[0]
     procs = tuple(int(q) for q in A.pids.flat)
-    with _tm.span("matmul.ring_ag", ranks=p):
-        mesh, ax, fn = _ring_ag_jit(procs, p, str(jnp.dtype(out_dtype)))
+    from .pallas_collectives import rdma_mode
+    rdma = rdma_mode()
+    with _tm.span("matmul.ring_ag", ranks=p,
+                  dispatch="rdma" if rdma else "xla"):
+        mesh, ax, fn = _ring_ag_jit(procs, p, str(jnp.dtype(out_dtype)),
+                                    rdma)
         with _tm.span("matmul.ring_ag.place", _journal=False):
             sh_in = NamedSharding(mesh, P(ax, None))
             a = _rs.reshard(A.garray, sh_in, op="matmul_place")
             b = _rs.reshard(B.garray, sh_in, op="matmul_place")
         with _tm.span("matmul.ring_ag.compute", _journal=False):
-            return fn(a, b)
+            if not rdma:
+                return fn(a, b)
+            try:
+                return fn(a, b)
+            except Exception as e:
+                # the RDMA arm must never cost correctness: rebuild the
+                # lax ring, loudly once per failure signature
+                from ..utils.debug import warn_once
+                warn_once(f"ring_ag:rdma:{type(e).__name__}",
+                          f"ring_ag RDMA path failed "
+                          f"({type(e).__name__}: {e}); falling back to "
+                          f"the XLA ppermute ring")
+                _, _, fn = _ring_ag_jit(procs, p,
+                                        str(jnp.dtype(out_dtype)), None)
+                return fn(a, b)
 
 
 def _dist_impl_choice(m, n, k, p, a_dtype, b_dtype):
@@ -588,7 +616,9 @@ def tune_matmul_impl_dist(m, n, k, p=None, dtype=jnp.float32, timer=None,
         raise ValueError(
             f"m ({m}) and k ({k}) must be divisible by p ({p})")
     procs = tuple(range(p))
-    mesh, ax, ring = _ring_ag_jit(procs, p, str(jnp.dtype(dtype)))
+    from .pallas_collectives import rdma_mode
+    mesh, ax, ring = _ring_ag_jit(procs, p, str(jnp.dtype(dtype)),
+                                  rdma_mode())
     sh = NamedSharding(mesh, P(ax, None))
     a = jax.device_put(jax.random.normal(  # dalint: disable=DAL007 — autotune staging of a fresh uncommitted array, nothing to plan
         jax.random.PRNGKey(0), (m, k), jnp.float32).astype(dtype), sh)

@@ -1,0 +1,832 @@
+"""Hand-rolled Pallas TPU RDMA ring collectives: overlap DMA with compute.
+
+Every inter-chip exchange in the framework used to be an XLA collective
+(``lax.all_gather`` / ``all_to_all`` / ``ppermute`` via the
+``parallel.collectives`` helpers).  XLA's collectives are asynchronous,
+but their *schedule* is XLA's: within one program the compiler sequences
+the wire time of a ring step against the MXU work of the same step more
+often than not.  This module owns the schedule explicitly, following the
+Pallas TPU distributed recipe (SNIPPETS.md [1]/[2], the
+``make_async_remote_copy`` send/recv-semaphore pattern) and the chunk
+decomposition of "Memory-efficient array redistribution through portable
+collective communication" (arXiv:2112.01075):
+
+- :func:`ring_all_gather` — forward-from-output ring: each rank DMAs the
+  block it most recently received straight out of its own output buffer
+  into its right neighbor's output buffer, so the concat IS the transfer
+  (zero staging) and the next incoming block rides the wire while the
+  previous forward drains (send semaphores double-buffered).
+- :func:`ring_reduce_scatter` — chunked traveling-partial ring: each
+  chunk runs a p-1-step ring whose per-step receive slots are
+  write-once (no reuse race by construction); the next local block's
+  HBM→VMEM copy overlaps the partial's RDMA hop; chunk-to-chunk slot
+  reuse is gated by a credit DMA from the consuming neighbor.
+- :func:`ring_all_to_all` — chunked bidirectional all-to-all: every
+  piece is DMA'd directly into its final offset of the destination
+  rank's output (write-once, zero staging), alternating ring direction
+  per destination distance so both ICI link directions carry traffic.
+- :func:`ring_allgather_matmul` / :func:`ring_allgather_matmul_rhs` /
+  :func:`ring_matmul_reducescatter` — the fused collective GEMMs: the
+  next chunk's RDMA is STARTED before the resident chunk's ``jnp.dot``
+  and WAITED after it, inside one kernel, so the MXU covers the wire
+  time (the overlap ``ops/collective_matmul`` can only hint to XLA).
+
+Semaphore protocol (shared by every kernel; docs/pallas_collectives.md
+has the worked schedule diagrams):
+
+- every remote copy carries a local *send* semaphore (signaled when the
+  source bytes have left) and a remote *receive* semaphore (signaled on
+  the destination chip when the bytes have landed);
+- buffers a peer writes into are either write-once for the kernel's
+  lifetime (all_gather, all_to_all, reduce-scatter recv slots within a
+  chunk) or revolve under an explicit **credit**: a 4-byte RDMA from
+  the consumer back to the producer that grants one more in-flight
+  transfer, because a DMA-semaphore wait alone only keeps neighbors
+  within one step of each other — one step is exactly the distance at
+  which a 2-slot buffer is overwritten mid-read;
+- all transfers of one kind are equal-sized, so a single receive
+  semaphore can accumulate several landings and be drained with one
+  descriptor wait per landing, in any order.
+
+Dispatch (mirrors ``pallas_gemm``'s ``pltpu is None`` guard): the RDMA
+kernels run compiled on real TPUs and in interpreter mode when forced
+(tests, ``DA_TPU_RDMA=interpret``); every other platform falls back to
+the bit-equivalent ``lax`` collective, counted via ``fallback.hits`` and
+warned once when RDMA was explicitly requested.  ``DA_TPU_RDMA=0`` is
+the kill switch.  ``DA_TPU_RDMA_CHUNKS`` pins the ring chunk depth;
+unset, it is derived from ``DA_TPU_RESHARD_CHUNK_MB`` (one chunk stages
+at most one reshard chunk target) with an ``"rdma_chunks"`` autotune
+registry entry taking precedence, the ``pallas_gemm`` pattern.
+
+All kernels assume the named mesh axis is the single axis of a 1-D mesh
+(logical device ids = ring positions) — true for every armed call site:
+the reshard planner's canonical mesh, ``linalg``'s ring_ag mesh, and the
+ring-attention mesh.  Do not arm them on multi-axis meshes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU-only namespace; absent/unusable off-TPU
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from .. import telemetry as _tm
+from ..parallel.collectives import (axis_size as _axis_size, pall_to_all,
+                                    pgather)
+
+__all__ = ["rdma_mode", "resolve_chunks", "ring_all_gather",
+           "ring_reduce_scatter", "ring_all_to_all",
+           "ring_allgather_matmul", "ring_allgather_matmul_rhs",
+           "ring_matmul_reducescatter", "gemm_ring_eligible"]
+
+
+RDMA_ENV = "DA_TPU_RDMA"
+CHUNKS_ENV = "DA_TPU_RDMA_CHUNKS"
+
+# scoped-VMEM budget for the fused GEMM rings — same silicon-measured
+# limit as pallas_gemm's tile sets
+_VMEM_LIMIT = int(15.5 * 2**20)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover - uninitialized backend
+        return False
+
+
+def rdma_mode(interpret: bool | None = None) -> str | None:
+    """The dispatch decision for one RDMA call site: ``"compiled"`` (real
+    TPU), ``"interpret"`` (forced — tests / ``DA_TPU_RDMA=interpret``),
+    or ``None`` (take the ``lax`` fallback).
+
+    ``DA_TPU_RDMA=0`` kills the RDMA path everywhere.  An explicit
+    ``DA_TPU_RDMA=1`` on a platform that cannot serve it warns once and
+    counts every hit (``fallback.hits``); the unset default stays quiet
+    off-TPU (nothing was promised)."""
+    env = os.environ.get(RDMA_ENV)
+    val = (env or "1").strip().lower()
+    if val in ("0", "off", "false"):
+        return None
+    if interpret or val == "interpret":
+        return "interpret" if pltpu is not None else None
+    if interpret is False:
+        # caller demands the compiled kernel or nothing
+        return "compiled" if (pltpu is not None and _on_tpu()) else None
+    if pltpu is not None and _on_tpu():
+        return "compiled"
+    if env is not None:
+        # RDMA was explicitly requested and cannot be served here
+        from ..utils.debug import warn_once
+        reason = "no pltpu" if pltpu is None else "platform not tpu"
+        warn_once(f"pallas_collectives:{reason}",
+                  f"DA_TPU_RDMA requested but unavailable ({reason}); "
+                  f"falling back to XLA collectives")
+    return None
+
+
+def _chunk_target_bytes() -> int:
+    # late import: parallel.reshard imports this module for its kernels
+    from ..parallel.reshard import _chunk_target_bytes as ct
+    return ct()
+
+
+def resolve_chunks(local_bytes: int, *key_parts) -> tuple[int, str]:
+    """The ring chunk depth for a transfer of ``local_bytes`` per device:
+    ``DA_TPU_RDMA_CHUNKS`` wins, else a valid ``"rdma_chunks"`` autotune
+    entry for this shape/platform, else derived so one chunk stays under
+    the ``DA_TPU_RESHARD_CHUNK_MB`` target.  Returns ``(chunks, source)``
+    — the source is banked as bench provenance and stamped on the
+    dispatch span."""
+    env = os.environ.get(CHUNKS_ENV)
+    if env:
+        try:
+            return max(int(env), 1), "env"
+        except ValueError:
+            pass
+    from ..utils import autotune
+    vals = autotune.valid_ints(
+        autotune.get("rdma_chunks", autotune.device_key_for(*key_parts)),
+        (1,))
+    if vals is not None:
+        return vals[0], "autotune"
+    derived = -(-int(local_bytes) // _chunk_target_bytes())   # ceil
+    return min(max(derived, 1), 64), "derived"
+
+
+def _record_dispatch(op: str, path: str, x, axis: str, **labels) -> None:
+    """Trace-time dispatch telemetry: a labeled counter plus, on the
+    RDMA path, a comm-byte record mirroring
+    ``parallel.collectives._rec`` (these helpers run inside shard_map
+    tracing — once per compilation, flagged traced).  The ``xla`` path
+    only counts the dispatch: its ``lax`` lowering records its own
+    bytes, and two records for one transfer would double-count."""
+    _tm.count("pallas_collectives.dispatch", op=op, path=path)
+    if path == "rdma" and _tm.enabled():
+        _tm.record_comm(op, _tm.nbytes_of(x), axis=axis, traced=True,
+                        dispatch=path,
+                        once_key=f"pallas_collectives:{op}:{path}:{axis}:"
+                                 f"{labels}", **labels)
+
+
+def _ds_at(ref, dim: int, start, size: int, ndim: int):
+    """``ref.at[..., pl.ds(start, size), ...]`` with the slice on ``dim``."""
+    idx = tuple(pl.ds(start, size) if d == dim else slice(None)
+                for d in range(ndim))
+    return ref.at[idx]
+
+
+def _mod(a, n: int):
+    """Nonnegative ``a % n`` for possibly-negative traced ``a``."""
+    return lax.rem(lax.rem(a, n) + n, n)
+
+
+def _copy(src, dst, sem):
+    c = pltpu.make_async_copy(src, dst, sem)
+    c.start()
+    c.wait()
+
+
+class _Credit:
+    """The 4-byte flow-control grant: ``grant(to)`` DMAs one credit to a
+    neighbor; ``take(frm)`` blocks until one credit has landed here.
+    Contents are irrelevant (only the receive semaphore's count matters);
+    concurrent grants into the same buffer are harmless."""
+
+    def __init__(self, buf_ref, send_sem, recv_sem):
+        self.buf, self.ssem, self.rsem = buf_ref, send_sem, recv_sem
+
+    def _desc(self, peer):
+        return pltpu.make_async_remote_copy(
+            src_ref=self.buf, dst_ref=self.buf,
+            send_sem=self.ssem, recv_sem=self.rsem,
+            device_id=peer, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def grant(self, to):
+        d = self._desc(to)
+        d.start()
+        d.wait_send()
+
+    def take(self, frm):
+        self._desc(frm).wait_recv()
+
+
+def _credit_scratch():
+    return [pltpu.VMEM((1, 1), jnp.int32),
+            pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA]
+
+
+# ---------------------------------------------------------------------------
+# ring all-gather
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _ag_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
+             interpret: bool):
+    dtype = jnp.dtype(dtype_str)
+    blk = shape[dim]
+    ndim = len(shape)
+    out_shape = tuple(blk * p if d == dim else s
+                      for d, s in enumerate(shape))
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem):
+        me = lax.axis_index(axis)
+        right = _mod(me + 1, p)
+
+        def blk_at(ref, i):
+            return _ds_at(ref, dim, i * blk, blk, ndim)
+
+        # local block straight to its output slot; must land before the
+        # first forward reads it
+        _copy(x_ref, blk_at(o_ref, me), copy_sem)
+        for t in range(p - 1):
+            src = _mod(me - t, p)            # block received at step t-1
+            s = t % 2
+            fwd = pltpu.make_async_remote_copy(
+                src_ref=blk_at(o_ref, src), dst_ref=blk_at(o_ref, src),
+                send_sem=send_sem.at[s], recv_sem=recv_sem.at[s],
+                device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+            if t >= 2:
+                # consume the step t-2 send on this semaphore slot before
+                # reusing it (equal sizes: any same-shaped descriptor
+                # drains exactly one forward)
+                fwd.wait_send()
+            fwd.start()
+            # the incoming block (me - t - 1) — left's step-t forward —
+            # rides the wire while ours drains; wait for it so the next
+            # step may forward it on
+            inc = _mod(me - t - 1, p)
+            pltpu.make_async_remote_copy(
+                src_ref=blk_at(o_ref, inc), dst_ref=blk_at(o_ref, inc),
+                send_sem=send_sem.at[s], recv_sem=recv_sem.at[s],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_recv()
+        # drain the last (up to) two in-flight sends
+        for t in range(max(p - 3, 0), p - 1):
+            pltpu.make_async_remote_copy(
+                src_ref=blk_at(o_ref, me), dst_ref=blk_at(o_ref, me),
+                send_sem=send_sem.at[t % 2], recv_sem=recv_sem.at[t % 2],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_send()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )
+
+
+def ring_all_gather(x, axis: str, *, dim: int = 0,
+                    interpret: bool | None = None):
+    """``lax.all_gather(x, axis, axis=dim, tiled=True)`` as a Pallas RDMA
+    ring (bit-identical: pure data movement).  Falls back to ``pgather``
+    off-TPU."""
+    p = _axis_size(axis)
+    if p == 1:
+        return x
+    mode = rdma_mode(interpret)
+    if mode is None:
+        _record_dispatch("ring_all_gather", "xla", x, axis)
+        return pgather(x, axis, tiled=True, dim=dim)
+    _record_dispatch("ring_all_gather", "rdma", x, axis, mode=mode)
+    shape = tuple(int(s) for s in x.shape)
+    return _ag_call(axis, p, shape, str(x.dtype), dim,
+                    mode == "interpret")(x)
+
+
+# ---------------------------------------------------------------------------
+# ring all-to-all
+# ---------------------------------------------------------------------------
+
+
+def _chunk_fit(extent: int, want: int) -> int:
+    """Largest divisor of ``extent`` that is <= ``want`` (>= 1)."""
+    want = max(min(want, extent), 1)
+    for c in range(want, 0, -1):
+        if extent % c == 0:
+            return c
+    return 1
+
+
+@functools.lru_cache(maxsize=256)
+def _a2a_call(axis: str, p: int, shape: tuple, dtype_str: str,
+              split_dim: int, concat_dim: int, nchunks: int,
+              interpret: bool):
+    dtype = jnp.dtype(dtype_str)
+    ndim = len(shape)
+    sblk = shape[split_dim] // p
+    out_shape = tuple(sblk if d == split_dim else
+                      (s * p if d == concat_dim else s)
+                      for d, s in enumerate(shape))
+    cext = shape[concat_dim]
+    nc = _chunk_fit(cext, nchunks)
+    piece = cext // nc
+    # destination distances, bidirectionally interleaved so both ICI link
+    # directions carry traffic: +1, -1, +2, -2, ...
+    offs = []
+    for s in range(1, p // 2 + 1):
+        offs.append(s)
+        if s != p - s:
+            offs.append(p - s)
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem):
+        me = lax.axis_index(axis)
+
+        def src_slc(ref, dst, c):
+            r = _ds_at(ref, split_dim, dst * sblk, sblk, ndim)
+            return _ds_at(r, concat_dim, c * piece, piece, ndim)
+
+        def dst_slc(ref, c):
+            # my piece lands at MY rank's concat offset in the peer's out
+            r = _ds_at(ref, concat_dim, me * cext + c * piece, piece, ndim)
+            return r
+
+        # the resident piece moves locally
+        _copy(_ds_at(x_ref, split_dim, me * sblk, sblk, ndim),
+              _ds_at(o_ref, concat_dim, me * cext, cext, ndim), copy_sem)
+        k = 0
+        for off in offs:
+            dst = _mod(me + off, p)
+            for c in range(nc):
+                d = pltpu.make_async_remote_copy(
+                    src_ref=src_slc(x_ref, dst, c),
+                    dst_ref=dst_slc(o_ref, c),
+                    send_sem=send_sem.at[k % 2], recv_sem=recv_sem,
+                    device_id=dst,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                if k >= 2:
+                    d.wait_send()            # free the revolving send slot
+                d.start()
+                k += 1
+        # drain sends, then the (p-1)*nc equal-sized landings — the
+        # receive semaphore accumulates them in any order
+        for k in range(max(k - 2, 0), k):
+            pltpu.make_async_remote_copy(
+                src_ref=src_slc(x_ref, me, 0), dst_ref=dst_slc(o_ref, 0),
+                send_sem=send_sem.at[k % 2], recv_sem=recv_sem,
+                device_id=me,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_send()
+        for _ in range((p - 1) * nc):
+            pltpu.make_async_remote_copy(
+                src_ref=src_slc(x_ref, me, 0), dst_ref=dst_slc(o_ref, 0),
+                send_sem=send_sem.at[0], recv_sem=recv_sem,
+                device_id=me,
+                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_recv()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )
+
+
+def a2a_chunks_for(local_shape, dtype_str: str, p: int,
+                   concat_dim: int | None = None) -> tuple[int, str]:
+    """The chunk depth :func:`ring_all_to_all` will use for a local
+    shard of ``local_shape`` — shared with the reshard planner so the
+    ``reshard`` span labels the depth the kernel actually runs.  With
+    ``concat_dim`` given, the resolved depth is clamped to a divisor of
+    that extent exactly like the kernel clamps it (span, bench row, and
+    kernel must agree)."""
+    nbytes = math.prod(local_shape) * jnp.dtype(dtype_str).itemsize
+    nc, src = resolve_chunks(nbytes // max(p, 1), "a2a", *local_shape,
+                             dtype_str, p)
+    if concat_dim is not None:
+        nc = _chunk_fit(int(local_shape[concat_dim]), nc)
+    return nc, src
+
+
+def ring_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
+                    chunks: int | None = None,
+                    interpret: bool | None = None):
+    """``lax.all_to_all(x, axis, split_dim, concat_dim, tiled=True)`` as
+    chunked bidirectional direct RDMA (bit-identical: pure data movement;
+    every piece lands at its final output offset, zero staging).
+    ``split_dim == concat_dim`` keeps the ``lax`` path."""
+    p = _axis_size(axis)
+    if p == 1:
+        return x
+    shape = tuple(int(s) for s in x.shape)
+    # split extent must divide evenly (the lax path raises properly;
+    # silent truncation would be wrong data)
+    mode = rdma_mode(interpret) if (split_dim != concat_dim
+                                    and shape[split_dim] % p == 0) else None
+    if mode is None:
+        _record_dispatch("ring_all_to_all", "xla", x, axis)
+        return pall_to_all(x, axis, split_dim=split_dim,
+                           concat_dim=concat_dim)
+    nc, src = (chunks, "arg") if chunks else a2a_chunks_for(
+        shape, str(x.dtype), p, concat_dim)
+    _record_dispatch("ring_all_to_all", "rdma", x, axis, mode=mode,
+                     chunks=nc, chunks_source=src)
+    return _a2a_call(axis, p, shape, str(x.dtype), split_dim, concat_dim,
+                     nc, mode == "interpret")(x)
+
+
+# ---------------------------------------------------------------------------
+# ring reduce-scatter
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _rs_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
+             nchunks: int, interpret: bool):
+    dtype = jnp.dtype(dtype_str)
+    ndim = len(shape)
+    oblk = shape[dim] // p
+    out_shape = tuple(oblk if d == dim else s for d, s in enumerate(shape))
+    # chunk along the largest axis of the OUTPUT block so the per-chunk
+    # staging (p-1 write-once receive slots + 2 revolving partials + 2
+    # prefetch slots, all VMEM) stays bounded; prefer an axis other than
+    # the scattered dim so the block and chunk slices stay on distinct
+    # axes
+    cands = sorted(range(ndim), key=lambda d: (d != dim, out_shape[d]))
+    cax = cands[-1]
+    nc = _chunk_fit(out_shape[cax], nchunks)
+    piece = tuple(s // nc if d == cax else s
+                  for d, s in enumerate(out_shape))
+
+    def kernel(x_ref, o_ref, recv, acc, tmp, send_sem, recv_sem, copy_sem,
+               tmp_sem, cbuf, csend, crecv):
+        me = lax.axis_index(axis)
+        right = _mod(me + 1, p)
+        left = _mod(me - 1, p)
+        credit = _Credit(cbuf, csend, crecv)
+
+        def x_piece(b, c):
+            r = _ds_at(x_ref, dim, b * oblk, oblk, ndim)
+            # nc == 1 keeps the block slice whole (also avoids chaining
+            # two slices on the same axis when ndim == 1 forces cax==dim)
+            return r if nc == 1 else _ds_at(r, cax, c * piece[cax],
+                                            piece[cax], ndim)
+
+        for c in range(nc):
+            if c >= 1:
+                # right must have consumed its chunk c-1 receive slots
+                # before this chunk's partials land in them
+                credit.take(right)
+            # seed: the partial destined (p-1) hops away starts here
+            _copy(x_piece(_mod(me - 1, p), c), acc.at[0], copy_sem)
+            a = 0
+            for t in range(p - 1):
+                d = pltpu.make_async_remote_copy(
+                    src_ref=acc.at[a], dst_ref=recv.at[t],
+                    send_sem=send_sem.at[a], recv_sem=recv_sem.at[t],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                d.start()
+                # prefetch the next local contribution while the partial
+                # rides the ring
+                nb = _mod(me - t - 2, p)
+                cp = pltpu.make_async_copy(x_piece(nb, c), tmp.at[a],
+                                           tmp_sem.at[a])
+                cp.start()
+                d.wait()                     # send drained + left's landed
+                cp.wait()
+                acc[1 - a] = recv[t] + tmp[a]
+                a = 1 - a
+            # chunk consumed: grant left one more chunk of credit
+            if c < nc - 1:
+                credit.grant(left)
+            out = _ds_at(o_ref, cax, c * piece[cax], piece[cax], ndim)
+            _copy(acc.at[a], out, copy_sem)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.VMEM((p - 1,) + piece, dtype),
+                        pltpu.VMEM((2,) + piece, dtype),
+                        pltpu.VMEM((2,) + piece, dtype),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((p - 1,)),
+                        pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA((2,))] + _credit_scratch(),
+        interpret=interpret,
+    )
+
+
+def _rs_vmem_bytes(shape, itemsize, p, nc, dim):
+    oblk_shape = [s // p if d == dim else s for d, s in enumerate(shape)]
+    cands = sorted(range(len(shape)),
+                   key=lambda d: (d != dim, oblk_shape[d]))
+    cax = cands[-1]
+    nc = _chunk_fit(oblk_shape[cax], nc)     # the depth the kernel fits
+    piece = math.prod(s // nc if d == cax else s
+                      for d, s in enumerate(oblk_shape))
+    return (p + 3) * piece * itemsize
+
+
+def ring_reduce_scatter(x, axis: str, *, dim: int = 0,
+                        chunks: int | None = None,
+                        interpret: bool | None = None):
+    """``lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)``
+    as a chunked Pallas RDMA traveling-partial ring.  Summation order is
+    the ring arrival order (exact for integer-valued data; float results
+    differ from XLA's reduction order by rounding only).  Needs the
+    scattered dim divisible by the axis size; falls back otherwise."""
+    p = _axis_size(axis)
+    if p == 1:
+        return x
+    mode = rdma_mode(interpret)
+    shape = tuple(int(s) for s in x.shape)
+    itemsize = jnp.dtype(x.dtype).itemsize
+    nc = src = None
+    if mode is not None and shape[dim] % p == 0:
+        blk_bytes = math.prod(shape) * itemsize // p
+        # the p-1 receive slots multiply the staged piece: derive with
+        # that factor so staging stays under the chunk target
+        nc, src = (chunks, "arg") if chunks else resolve_chunks(
+            blk_bytes * (p - 1), "rs", *shape, str(x.dtype), p)
+        if mode == "compiled" and \
+                _rs_vmem_bytes(shape, itemsize, p, nc, dim) > _VMEM_LIMIT:
+            mode = None                      # slots cannot fit VMEM
+    elif shape[dim] % p:
+        mode = None
+    if mode is None:
+        _record_dispatch("ring_reduce_scatter", "xla", x, axis)
+        return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
+    _record_dispatch("ring_reduce_scatter", "rdma", x, axis, mode=mode,
+                     chunks=nc, chunks_source=src)
+    return _rs_call(axis, p, shape, str(x.dtype), dim, nc,
+                    mode == "interpret")(x)
+
+
+# ---------------------------------------------------------------------------
+# fused ring GEMMs: DMA started before the resident chunk's dot, waited
+# after it — the compute/comm overlap the XLA ring can only hint at
+# ---------------------------------------------------------------------------
+
+
+def gemm_ring_eligible(kind: str, x_shape, w_shape, p: int, itemsize: int,
+                       out_itemsize: int = 4) -> bool:
+    """VMEM-budget gate for the fused ring GEMMs: the revolving operand
+    slots, the resident stationary operand, and the output/accumulator
+    must fit the scoped-VMEM budget together."""
+    xb = math.prod(x_shape) * itemsize
+    wb = math.prod(w_shape) * itemsize
+    if kind == "ag":        # out (p*m_loc, n) + 2 slots of x + w
+        ob = x_shape[0] * p * w_shape[1] * out_itemsize
+        need = 2 * xb + wb + ob
+    elif kind == "ag_rhs":  # 2 slots of traveling b (x_shape) + resident
+        # a (w_shape = (m_loc, k)) + the (m_loc, n) accumulator
+        ob = w_shape[0] * x_shape[1] * out_itemsize
+        need = 2 * xb + wb + ob
+    else:                   # rs: 2 acc + 2 recv of (m/p, n) + w + x
+        ob = (x_shape[0] // p) * w_shape[1] * out_itemsize
+        need = 4 * ob + wb + xb
+    return need <= _VMEM_LIMIT
+
+
+@functools.lru_cache(maxsize=128)
+def _ag_mm_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
+                out_dtype_str: str, interpret: bool):
+    m_loc, k = xs
+    n = ws[1]
+    dtype = jnp.dtype(dtype_str)
+    out_dtype = jnp.dtype(out_dtype_str)
+
+    def kernel(x_ref, w_ref, o_ref, buf, send_sem, recv_sem, copy_sem,
+               cbuf, csend, crecv):
+        me = lax.axis_index(axis)
+        left = _mod(me - 1, p)
+        right = _mod(me + 1, p)
+        credit = _Credit(cbuf, csend, crecv)
+        _copy(x_ref, buf.at[0], copy_sem)
+        for t in range(p):
+            s = t % 2
+            # the lax path's schedule: resident chunk originated at rank
+            # me + t (pshift(-1) = fetch from the right neighbor)
+            src = _mod(me + t, p)
+            if t < p - 1:
+                if t >= 2:
+                    credit.take(left)        # left freed the slot we hit
+                fwd = pltpu.make_async_remote_copy(
+                    src_ref=buf.at[s], dst_ref=buf.at[1 - s],
+                    send_sem=send_sem.at[s], recv_sem=recv_sem.at[1 - s],
+                    device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                fwd.start()
+            # resident chunk multiplies while the forward is in flight
+            o_ref[pl.ds(src * m_loc, m_loc)] = jnp.dot(
+                buf[s], w_ref[...],
+                preferred_element_type=jnp.float32).astype(out_dtype)
+            if t < p - 1:
+                fwd.wait()
+                if 1 <= t <= p - 3:
+                    # slot s consumed; balance exactly against the
+                    # takes (sems must drain to zero at kernel exit)
+                    credit.grant(right)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((p * m_loc, n), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((2, m_loc, k), dtype),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA] + _credit_scratch(),
+        interpret=interpret,
+    )
+
+
+def ring_allgather_matmul(x, w, axis: str, *,
+                          interpret: bool | None = None):
+    """``allgather_matmul``'s contract as one fused Pallas kernel: the
+    next chunk's RDMA is started before the resident chunk's dot and
+    waited after it.  Forward-only (no VJP); callers arm it on 1-D
+    meshes for inference paths."""
+    p = _axis_size(axis)
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    mode = rdma_mode(interpret)
+    if mode == "compiled" and not gemm_ring_eligible(
+            "ag", x.shape, w.shape, p,
+            jnp.dtype(x.dtype).itemsize,
+            jnp.dtype(out_dtype).itemsize):
+        mode = None
+    if p == 1 or mode is None or x.dtype != w.dtype:
+        return None                          # caller takes the lax path
+    _record_dispatch("ring_allgather_matmul", "rdma", x, axis, mode=mode)
+    return _ag_mm_call(axis, p, tuple(map(int, x.shape)),
+                       tuple(map(int, w.shape)), str(x.dtype),
+                       str(out_dtype), mode == "interpret")(x, w)
+
+
+@functools.lru_cache(maxsize=128)
+def _ag_mm_rhs_call(axis: str, p: int, as_: tuple, bs: tuple,
+                    dtype_str: str, out_dtype_str: str, interpret: bool):
+    m_loc, _k = as_
+    k_loc, n = bs
+    dtype = jnp.dtype(dtype_str)
+    out_dtype = jnp.dtype(out_dtype_str)
+
+    def kernel(a_ref, b_ref, o_ref, buf, send_sem, recv_sem, copy_sem,
+               cbuf, csend, crecv):
+        me = lax.axis_index(axis)
+        left = _mod(me - 1, p)
+        right = _mod(me + 1, p)
+        credit = _Credit(cbuf, csend, crecv)
+        _copy(b_ref, buf.at[0], copy_sem)
+        for t in range(p):
+            s = t % 2
+            src = _mod(me + t, p)
+            if t < p - 1:
+                if t >= 2:
+                    credit.take(left)
+                fwd = pltpu.make_async_remote_copy(
+                    src_ref=buf.at[s], dst_ref=buf.at[1 - s],
+                    send_sem=send_sem.at[s], recv_sem=recv_sem.at[1 - s],
+                    device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                fwd.start()
+            # resident chunk contracts against its column slice of a —
+            # cast per step like the lax path's ``part``
+            part = jnp.dot(a_ref[:, pl.ds(src * k_loc, k_loc)], buf[s],
+                           preferred_element_type=jnp.float32
+                           ).astype(out_dtype)
+            if t == 0:
+                o_ref[...] = part
+            else:
+                o_ref[...] = o_ref[...] + part
+            if t < p - 1:
+                fwd.wait()
+                if 1 <= t <= p - 3:          # balance against the takes
+                    credit.grant(right)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m_loc, n), out_dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((2, k_loc, n), dtype),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA] + _credit_scratch(),
+        interpret=interpret,
+    )
+
+
+def ring_allgather_matmul_rhs(a, b, axis: str, *,
+                              interpret: bool | None = None):
+    """``allgather_matmul_rhs``'s contract fused: the traveling B chunk's
+    forward RDMA overlaps the resident chunk's contraction."""
+    p = _axis_size(axis)
+    out_dtype = jnp.result_type(a.dtype, b.dtype)
+    mode = rdma_mode(interpret)
+    if mode == "compiled" and not gemm_ring_eligible(
+            "ag_rhs", b.shape, a.shape, p,
+            jnp.dtype(b.dtype).itemsize,
+            jnp.dtype(out_dtype).itemsize):
+        mode = None
+    if p == 1 or mode is None or a.dtype != b.dtype:
+        return None
+    _record_dispatch("ring_allgather_matmul_rhs", "rdma", b, axis,
+                     mode=mode)
+    return _ag_mm_rhs_call(axis, p, tuple(map(int, a.shape)),
+                           tuple(map(int, b.shape)), str(a.dtype),
+                           str(out_dtype), mode == "interpret")(a, b)
+
+
+@functools.lru_cache(maxsize=128)
+def _mm_rs_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
+                interpret: bool):
+    m, k_loc = xs
+    n = ws[1]
+    m_loc = m // p
+    dtype = jnp.dtype(dtype_str)
+
+    def kernel(x_ref, w_ref, o_ref, acc, recv, send_sem, recv_sem,
+               cbuf, csend, crecv):
+        me = lax.axis_index(axis)
+        left = _mod(me - 1, p)
+        right = _mod(me + 1, p)
+        credit = _Credit(cbuf, csend, crecv)
+
+        def block(d):
+            return jnp.dot(x_ref[pl.ds(d * m_loc, m_loc)], w_ref[...],
+                           preferred_element_type=jnp.float32
+                           ).astype(dtype)
+
+        # the lax path: acc seeds with destination (me - 1), forwards to
+        # the RIGHT, and accumulates block (me - 1 - t) at step t
+        acc[0] = block(_mod(me - 1, p))
+        a = 0
+        for t in range(1, p):
+            s = t % 2                        # revolving recv/send slots
+            d = pltpu.make_async_remote_copy(
+                src_ref=acc.at[a], dst_ref=recv.at[s],
+                send_sem=send_sem.at[a], recv_sem=recv_sem.at[s],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            if t >= 3:
+                credit.take(right)           # right freed recv slot s
+            d.start()
+            # next destination block's GEMM runs while the partial rides
+            g = block(_mod(me - 1 - t, p))
+            d.wait()
+            acc[1 - a] = recv[s] + g
+            a = 1 - a
+            if 1 <= t <= p - 3:              # balance against the takes
+                credit.grant(left)
+        _copy_out = pltpu.make_async_copy(acc.at[a], o_ref, csend)
+        _copy_out.start()
+        _copy_out.wait()
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m_loc, n), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.VMEM((2, m_loc, n), dtype),
+                        pltpu.VMEM((2, m_loc, n), dtype),
+                        pltpu.SemaphoreType.DMA((2,)),
+                        pltpu.SemaphoreType.DMA((2,))] + _credit_scratch(),
+        interpret=interpret,
+    )
+
+
+def ring_matmul_reducescatter(x, w, axis: str, *,
+                              interpret: bool | None = None):
+    """``matmul_reducescatter``'s contract fused: each destination
+    block's GEMM runs while the traveling partial's RDMA is in flight."""
+    p = _axis_size(axis)
+    mode = rdma_mode(interpret)
+    if mode == "compiled" and not gemm_ring_eligible(
+            "rs", x.shape, w.shape, p, jnp.dtype(x.dtype).itemsize,
+            jnp.dtype(jnp.result_type(x.dtype, w.dtype)).itemsize):
+        mode = None
+    if p == 1 or mode is None or x.dtype != w.dtype or x.shape[0] % p:
+        return None
+    _record_dispatch("ring_matmul_reducescatter", "rdma", x, axis,
+                     mode=mode)
+    out_dtype = jnp.result_type(x.dtype, w.dtype)
+    return _mm_rs_call(axis, p, tuple(map(int, x.shape)),
+                       tuple(map(int, w.shape)), str(out_dtype),
+                       mode == "interpret")(x.astype(out_dtype),
+                                            w.astype(out_dtype))

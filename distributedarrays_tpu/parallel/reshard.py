@@ -354,20 +354,34 @@ def _spec_for(dim, ndim, axis):
 
 @functools.lru_cache(maxsize=512)
 def _collective_jit(mesh, strategy, ndim, src_dim, dst_dim, p,
-                    chunk_axis, nchunks):
+                    chunk_axis, nchunks, rdma=None):
     """ONE compiled shard_map program for a planned single-axis
     repartition, chunked so each collective stages at most 1/nchunks of
-    the local shard."""
+    the local shard.  With ``rdma`` set (``"compiled"``/``"interpret"``,
+    from :func:`ops.pallas_collectives.rdma_mode`) the inner exchange is
+    the Pallas RDMA ring kernel instead of the XLA collective: chunk
+    DMAs land directly at their output offsets (no XLA-level staging
+    loop needed — the kernel double-buffers internally), overlapping
+    wire time with the slice/concat work."""
     _tm.count("jit.builds", fn="reshard_collective")
     # cold path: lru-miss body, once per distinct planned program
     _tm.event("jit", "build", fn="reshard_collective",  # dalint: disable=DAL003
-              strategy=strategy, nchunks=nchunks)
+              strategy=strategy, nchunks=nchunks, rdma=str(rdma))
     axis = mesh.axis_names[0]
     in_spec = _spec_for(src_dim, ndim, axis)
     out_spec = _spec_for(dst_dim, ndim, axis) if strategy != "all_gather" \
         else P(*([None] * ndim))
 
     def kernel(x):
+        if rdma and strategy in ("all_to_all", "all_gather"):
+            from ..ops import pallas_collectives as _pc
+            interp = rdma == "interpret"
+            if strategy == "all_to_all":
+                return _pc.ring_all_to_all(x, axis, split_dim=dst_dim,
+                                           concat_dim=src_dim,
+                                           interpret=interp)
+            return _pc.ring_all_gather(x, axis, dim=src_dim,
+                                       interpret=interp)
         if strategy == "all_to_all":
             if nchunks <= 1:
                 return pall_to_all(x, axis, split_dim=dst_dim,
@@ -410,14 +424,17 @@ def _collective_jit(mesh, strategy, ndim, src_dim, dst_dim, p,
         blk = x.shape[dst_dim] // p
         return lax.dynamic_slice_in_dim(x, r * blk, blk, axis=dst_dim)
 
-    return jax.jit(shard_map_compat(kernel, mesh, in_spec, out_spec))
+    # pallas_call has no shard_map replication rule: the RDMA variant
+    # must opt out of the check (the XLA variant keeps it)
+    return jax.jit(shard_map_compat(kernel, mesh, in_spec, out_spec,
+                                    check=False if rdma else None))
 
 
-def _run_collective(x, dst_sharding, plan: ReshardPlan):
+def _run_collective(x, dst_sharding, plan: ReshardPlan, rdma=None):
     mesh = L.mesh_for(list(plan.ranks), (plan.nparts,))
     fn = _collective_jit(mesh, plan.strategy, len(plan.shape),
                          plan.src_dim, plan.dst_dim, plan.nparts,
-                         plan.chunk_axis, plan.nchunks)
+                         plan.chunk_axis, plan.nchunks, rdma)
     y = fn(x)
     if y.sharding != dst_sharding:
         # equivalent placement under the caller's sharding object —
@@ -469,7 +486,25 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
         plan = plan_reshard(x, dst_sharding)
     if plan.strategy == "noop":
         return x
-    with _tm.span("reshard", op=op, strategy=plan.strategy):
+    # RDMA dispatch decided eagerly so the compiled program is keyed on
+    # it (flipping DA_TPU_RDMA re-jits) and the span says which path ran
+    rdma = None
+    rdma_chunks = 0
+    chunks_src = ""
+    if plan.collective and plan.strategy in ("all_to_all", "all_gather"):
+        from ..ops import pallas_collectives as _pc
+        rdma = _pc.rdma_mode()
+        if rdma and plan.strategy == "all_to_all":
+            lshape = tuple(s // plan.nparts if d == plan.src_dim else s
+                           for d, s in enumerate(plan.shape))
+            # the kernel concats along the plan's src dim; clamping here
+            # keeps span/bench provenance equal to the depth it runs
+            rdma_chunks, chunks_src = _pc.a2a_chunks_for(
+                lshape, str(getattr(x, "dtype", "float32")), plan.nparts,
+                plan.src_dim)
+    with _tm.span("reshard", op=op, strategy=plan.strategy,
+                  dispatch="rdma" if rdma else "xla",
+                  rdma_chunks=rdma_chunks, rdma_chunks_source=chunks_src):
         if plan.collective:
             # chaos site: an armed fault plan can abort the planned
             # collective here — mid-reshard, before any chunk moves, so
@@ -485,11 +520,18 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
                 # regressions, not compiled-program memory use
                 local = plan.total_bytes // max(plan.nparts, 1)
                 piece = -(-local // max(plan.nchunks, 1))
+                if rdma and plan.strategy == "all_to_all":
+                    # the RDMA ring lands chunk DMAs at their final
+                    # output offsets; what stages per device is one
+                    # in-flight chunk window, not an XLA concat buffer
+                    piece = min(piece,
+                                -(-local // max(rdma_chunks, 1)))
                 with _tm.memory.staging(f"reshard.{plan.strategy}", piece):
-                    out = _run_collective(x, dst_sharding, plan)
+                    out = _run_collective(x, dst_sharding, plan, rdma)
                 if _tm.enabled():
                     _tm.record_comm("reshard", plan.moved_bytes, op=op,
                                     strategy=plan.strategy,
+                                    dispatch="rdma" if rdma else "xla",
                                     shape=list(plan.shape))
                 return out
             except Exception as e:

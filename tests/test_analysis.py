@@ -309,6 +309,16 @@ def test_dal007_silent_in_reshard_home():
         src, "distributedarrays_tpu/parallel/reshard.py")) == []
 
 
+def test_dal007_silent_in_pallas_collectives_home():
+    # the RDMA ring kernels are the planner's own inner exchange: their
+    # call sites are planned moves, not planner bypasses
+    src = ("import jax\n"
+           "def stage(x, sharding):\n"
+           "    return jax.device_put(x, sharding)\n")
+    assert codes(lint_source(
+        src, "distributedarrays_tpu/ops/pallas_collectives.py")) == []
+
+
 def test_dal007_silent_on_bare_device_targets():
     src = ("import jax\n"
            "def pin(x):\n"

@@ -313,3 +313,44 @@ def test_dmatmul_int8_compiled():
         assert np.abs(got - want).max() / np.abs(want).max() < 3e-2
     finally:
         dat.d_closeall()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="RDMA ring collectives need >= 2 chips")
+def test_rdma_ring_collectives_compiled():
+    # COMPILED-mode oracle for the PR 8 RDMA rings on a real multi-chip
+    # slice: the interpret-mode suite proves the schedule, this proves
+    # the Mosaic lowering (semaphore allocation, LOGICAL device ids,
+    # credit DMAs) on silicon.  Same bit-identity contract.
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from distributedarrays_tpu.ops import pallas_collectives as PC
+    from distributedarrays_tpu.ops.collective_matmul import \
+        allgather_matmul_rhs
+    from distributedarrays_tpu.parallel.collectives import (run_spmd,
+                                                            spmd_mesh)
+    p = len(jax.devices())
+    mesh = spmd_mesh(p)
+    rng = np.random.default_rng(0)
+    x = rng.integers(-8, 8, (p * 8, p * 128)).astype(np.float32)
+    spec = P("p", None)
+    y1 = run_spmd(lambda a: PC.ring_all_gather(a, "p", interpret=False),
+                  mesh, (spec,), P(None, None))(x)
+    y2 = run_spmd(lambda a: lax.all_gather(a, "p", axis=0, tiled=True),
+                  mesh, (spec,), P(None, None))(x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    y1 = run_spmd(lambda a: PC.ring_all_to_all(
+        a, "p", split_dim=1, concat_dim=0, interpret=False),
+        mesh, (spec,), spec)(x)
+    y2 = run_spmd(lambda a: lax.all_to_all(
+        a, "p", split_axis=1, concat_axis=0, tiled=True),
+        mesh, (spec,), spec)(x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    a = rng.integers(-4, 4, (p * 128, p * 128)).astype(np.float32)
+    b = rng.integers(-4, 4, (p * 128, 256)).astype(np.float32)
+    y1 = run_spmd(lambda aa, bb: allgather_matmul_rhs(
+        aa, bb, "p", rdma=True, interpret=False),
+        mesh, (spec, spec), spec)(a, b)
+    y2 = run_spmd(lambda aa, bb: allgather_matmul_rhs(aa, bb, "p"),
+                  mesh, (spec, spec), spec)(a, b)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))

@@ -83,6 +83,20 @@ print('elastic-smoke-ok', len(ranks), 'devices')
       else
         echo "(no telemetry journal produced)" >> "$LOG"
       fi
+      echo "=== RDMA vs XLA (pallas_collectives) ===" >> "$LOG"
+      timeout 60 python - >> "$LOG" 2>&1 <<'PYEOF'
+import json
+d = json.load(open("/root/repo/BENCH_DETAILS.json"))
+for row, keys in (
+    ("ring_gemm", ("dispatch", "xla_s", "rdma_s", "xla_tflops",
+                   "rdma_tflops")),
+    ("reshard_even", ("dispatch", "strategy", "s", "gbps",
+                      "rdma_chunks", "rdma_chunks_source")),
+):
+    got = {k: d.get(f"{row}_{k}") for k in keys
+           if d.get(f"{row}_{k}") is not None}
+    print(f"{row}: {got if got else 'not banked this run'}")
+PYEOF
       echo "=== running TPU test leg ===" >> "$LOG"
       DAT_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_compiled.py -q >> "$LOG" 2>&1
       echo "=== tpu tests rc=$? $(date -u) ===" >> "$LOG"
