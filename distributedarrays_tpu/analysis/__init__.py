@@ -8,9 +8,19 @@ inside jitted hot paths, unbound mesh axis names, unguarded telemetry in
 hot paths, DArray leaks in loops.  Two halves:
 
 - **dalint** (``engine``/``rules``): an AST linter with stable rule codes
-  (DAL001-DAL006), per-line ``# dalint: disable=CODE`` suppressions, and a
-  CLI — ``python -m distributedarrays_tpu.analysis lint`` or the
-  ``tools/dalint`` wrapper.  Rule catalog: ``docs/analysis.md``.
+  (DAL001-DAL009), per-line ``# dalint: disable=CODE`` suppressions,
+  unused-suppression detection (DAL100), and a CLI — ``python -m
+  distributedarrays_tpu.analysis lint`` or the ``tools/dalint`` wrapper
+  (``--changed`` fast mode, ``--format=json|github``).  Rule catalog:
+  ``docs/analysis.md``.  DAL008/DAL009 delegate to ``locks``, the
+  interprocedural lock-order / blocking-under-lock analysis (cross-file
+  sweep: the ``locks`` CLI verb).
+- **protocol**: an explicit-state model checker for the Pallas RDMA
+  ring-kernel schedules (``ops/ring_schedules.py``) — proves semaphore
+  drain, no in-flight slot races, write-once discipline, and absence of
+  starvation over every rank-asynchronous interleaving, with a mutation
+  harness proving the checker catches the bug classes the credits
+  exist for (``verify-protocols`` CLI verb).
 - **divergence**: an opt-in runtime checker
   (``DA_TPU_CHECK_DIVERGENCE=1``) that records each rank's eager
   collective sequence under ``parallel.spmd`` and aborts with a per-rank
@@ -18,14 +28,16 @@ hot paths, DArray leaks in loops.  Two halves:
 """
 
 from .engine import (Finding, lint_source, lint_file, lint_paths,
-                     iter_python_files, parse_suppressions)
+                     iter_python_files, parse_suppressions,
+                     unused_suppressions)
 from .rules import RULES, Rule
 from .divergence import (CollectiveDivergenceError, DivergenceChecker,
                          checking, payload_signature)
 
 __all__ = [
     "Finding", "lint_source", "lint_file", "lint_paths",
-    "iter_python_files", "parse_suppressions", "RULES", "Rule",
+    "iter_python_files", "parse_suppressions", "unused_suppressions",
+    "RULES", "Rule",
     "CollectiveDivergenceError", "DivergenceChecker", "checking",
     "payload_signature",
 ]

@@ -2,22 +2,196 @@
 
     python -m distributedarrays_tpu.analysis lint [paths...]
     python -m distributedarrays_tpu.analysis rules
+    python -m distributedarrays_tpu.analysis verify-protocols
+    python -m distributedarrays_tpu.analysis locks [paths...]
 
 ``lint`` exits 0 when every finding is suppressed (or none exist), 1
 otherwise — the CI / tpu_watch gate.  Default paths are the package's own
-lint surface: ``distributedarrays_tpu examples bench.py``.
+lint surface: ``distributedarrays_tpu examples bench.py``.  Output
+formats: ``--format=text`` (default), ``json`` (one object per finding),
+``github`` (workflow-command annotations rendered inline on PR diffs).
+``--warn-unused-suppressions`` reports ``# dalint: disable=`` comments
+that silence nothing (code DAL100, on in CI so justified suppressions
+cannot rot); ``--changed`` lints only files that differ from the git
+merge base (plus uncommitted/untracked) — the pre-commit fast mode.
+
+``verify-protocols`` model-checks the declarative RDMA ring-kernel
+schedules (``analysis.protocol``) and refutes the seeded mutants;
+``locks`` runs the cross-file lock-order / blocking-under-lock analysis
+(``analysis.locks``) and prints the acquisition graph.  Both exit 1 on
+failure so they slot straight into CI legs.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 from pathlib import Path
 
-from .engine import lint_paths
+from .engine import lint_file, unused_suppressions
 from .rules import RULES
 
 DEFAULT_TARGETS = ["distributedarrays_tpu", "examples", "bench.py"]
+
+_SEV_GH = {"error": "error", "warning": "warning", "info": "notice"}
+
+
+def _emit(findings, fmt: str) -> None:
+    if fmt == "json":
+        print(json.dumps([{
+            "path": f.path, "line": f.line, "col": f.col,
+            "code": f.code, "severity": f.severity,
+            "message": f.message, "suppressed": f.suppressed,
+        } for f in findings], indent=2))
+        return
+    for f in findings:
+        if fmt == "github":
+            # workflow commands; GitHub renders them inline on the diff
+            msg = f.message.replace("%", "%25").replace("\r", "%0D") \
+                           .replace("\n", "%0A")
+            print(f"::{_SEV_GH.get(f.severity, 'warning')} "
+                  f"file={f.path},line={f.line},col={max(f.col, 1)},"
+                  f"title={f.code}::{msg}")
+        else:
+            print(f.format())
+
+
+def _changed_files(base: str | None) -> tuple[list[str] | None, str | None]:
+    """``(paths, error)``: paths differing from the merge base with
+    ``base`` (or the first of origin/main, origin/master, main, master
+    that resolves), plus uncommitted and untracked files.  ``error``
+    is a message when the mode cannot run honestly — git unavailable,
+    or no merge base resolved (a typo'd ``--base``, a default branch
+    outside the fallback chain): linting only the uncommitted files
+    then would silently pass bad committed ones."""
+    def git(*args):
+        try:
+            r = subprocess.run(["git", *args], capture_output=True,
+                               text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return r.stdout.strip() if r.returncode == 0 else None
+
+    if git("rev-parse", "--git-dir") is None:
+        return None, "--changed needs a git checkout"
+    candidates = ([base] if base else
+                  ["origin/main", "origin/master", "main", "master"])
+    merge_base = None
+    for cand in candidates:
+        merge_base = git("merge-base", "HEAD", cand)
+        if merge_base:
+            break
+    if merge_base is None:
+        return None, ("--changed found no merge base (tried "
+                      + ", ".join(candidates)
+                      + "); pass --base REF for this checkout")
+    out: set[str] = set()
+    committed = git("diff", "--name-only", merge_base, "HEAD")
+    if committed:
+        out.update(committed.splitlines())
+    for extra in (git("diff", "--name-only", "HEAD"),
+                  git("ls-files", "--others", "--exclude-standard")):
+        if extra:
+            out.update(extra.splitlines())
+    # deleted/renamed-away paths still appear in the diffs; linting
+    # them would fail every commit that removes a .py file
+    return sorted(p for p in out
+                  if p.endswith(".py") and Path(p).exists()), None
+
+
+def _cmd_lint(args) -> int:
+    select = args.select.split(",") if args.select else None
+    if args.changed:
+        changed, err = _changed_files(args.base)
+        if changed is None:
+            print(f"dalint: {err}", file=sys.stderr)
+            return 2
+        scope = args.paths or [p for p in DEFAULT_TARGETS
+                               if Path(p).exists()]
+        roots = [Path(p).resolve() for p in scope]
+        files = []
+        for c in changed:
+            rc = Path(c).resolve()
+            if any(rc == r or r in rc.parents for r in roots):
+                files.append(c)
+        if not files:
+            print("dalint: no changed files under the lint surface "
+                  "(clean by construction)")
+            return 0
+        paths = files
+    else:
+        paths = args.paths or [p for p in DEFAULT_TARGETS
+                               if Path(p).exists()]
+        if not paths:
+            # zero resolved targets must NOT read as a clean gate (e.g.
+            # the bare module invoked outside the repo root without
+            # arguments)
+            print("dalint: no lint targets found (run from the repo "
+                  "root or pass explicit paths)", file=sys.stderr)
+            return 2
+
+    from .engine import iter_python_files
+    findings = []
+    for f in iter_python_files(paths):
+        per_file = lint_file(f, select)
+        findings.extend(per_file)
+        if args.warn_unused_suppressions:
+            try:
+                src = Path(f).read_text()
+            except (OSError, UnicodeDecodeError):
+                continue
+            findings.extend(unused_suppressions(
+                src, str(f), per_file,
+                select if select is not None else None))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else active
+    _emit(shown, args.format)
+    n_sup = sum(1 for f in findings if f.suppressed)
+    if args.format != "json":
+        print(f"dalint: {len(active)} finding(s), {n_sup} suppressed, "
+              f"{len(paths)} path(s)")
+    return 1 if active else 0
+
+
+def _cmd_verify_protocols(args) -> int:
+    from . import protocol
+
+    ps = tuple(int(x) for x in args.ps.split(",")) if args.ps \
+        else protocol.DEFAULT_PS
+    depths = tuple(int(x) for x in args.depths.split(",")) if args.depths \
+        else protocol.DEFAULT_DEPTHS
+    kw = {}
+    if args.max_states is not None:
+        kw["max_states"] = args.max_states
+    report = protocol.verify_protocols(
+        ps=ps, depths=depths, mutants=not args.no_mutants, **kw)
+    print(protocol.format_report(
+        report, verbose_counterexamples=not args.quiet))
+    return 0 if report["ok"] else 1
+
+
+def _cmd_locks(args) -> int:
+    from . import locks
+
+    paths = args.paths or [p for p in locks.DEFAULT_LOCK_TARGETS
+                           if Path(p).exists()]
+    if not paths:
+        print("locks: no analysis targets found (run from the repo "
+              "root or pass explicit paths)", file=sys.stderr)
+        return 2
+    report = locks.analyze_paths(paths)
+    active = [f for f in report.findings if not f.suppressed]
+    shown = report.findings if args.show_suppressed else active
+    _emit(shown, args.format)
+    if args.format != "json":
+        print(locks.format_graph(report))
+        n_sup = sum(1 for f in report.findings if f.suppressed)
+        print(f"locks: {len(active)} finding(s), {n_sup} suppressed, "
+              f"{len(paths)} path(s)")
+    return 1 if active else 0
 
 
 def main(argv=None) -> int:
@@ -35,35 +209,65 @@ def main(argv=None) -> int:
     lint.add_argument("--show-suppressed", action="store_true",
                       help="also print findings silenced by "
                            "`# dalint: disable=` comments")
+    lint.add_argument("--format", choices=("text", "json", "github"),
+                      default="text",
+                      help="output format (github = workflow-command "
+                           "annotations rendered inline on PR diffs)")
+    lint.add_argument("--warn-unused-suppressions", action="store_true",
+                      help="report disable= comments that silence "
+                           "nothing (DAL100; on in CI)")
+    lint.add_argument("--changed", action="store_true",
+                      help="lint only files differing from the git "
+                           "merge base (+ uncommitted/untracked) — "
+                           "pre-commit fast mode")
+    lint.add_argument("--base", default=None,
+                      help="merge-base ref for --changed (default: "
+                           "origin/main, origin/master, main, master)")
 
     sub.add_parser("rules", help="print the rule catalog")
+
+    vp = sub.add_parser(
+        "verify-protocols",
+        help="model-check the RDMA ring-kernel schedules + refute the "
+             "seeded mutants")
+    vp.add_argument("--ps", default=None,
+                    help="comma-separated rank counts (default "
+                         "2,3,4,8 — 8 for the windowed kernels only; "
+                         "see analysis.protocol.DEFAULT_PS)")
+    vp.add_argument("--depths", default=None,
+                    help="comma-separated chunk depths for the chunked "
+                         "kernels (default 1,2)")
+    vp.add_argument("--no-mutants", action="store_true",
+                    help="skip the mutation harness")
+    vp.add_argument("--max-states", type=int, default=None,
+                    help="state budget per schedule (exceeding it is "
+                         "a FAILURE, not a pass)")
+    vp.add_argument("--quiet", action="store_true",
+                    help="suppress interleaving counterexample traces")
+
+    lk = sub.add_parser(
+        "locks",
+        help="cross-file lock-order + blocking-under-lock analysis")
+    lk.add_argument("paths", nargs="*",
+                    help="files or directories (default: the serve/"
+                         "telemetry/resilience/parallel lock surface)")
+    lk.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
+    lk.add_argument("--show-suppressed", action="store_true")
 
     args = parser.parse_args(argv)
     if args.cmd == "rules":
         for code, rule in sorted(RULES.items()):
             print(f"{code} [{rule.severity}] {rule.title}")
         return 0
-    if args.cmd != "lint":
-        parser.print_help()
-        return 2
-
-    paths = args.paths or [p for p in DEFAULT_TARGETS if Path(p).exists()]
-    if not paths:
-        # zero resolved targets must NOT read as a clean gate (e.g. the
-        # bare module invoked outside the repo root without arguments)
-        print("dalint: no lint targets found (run from the repo root or "
-              "pass explicit paths)", file=sys.stderr)
-        return 2
-    select = args.select.split(",") if args.select else None
-    findings = lint_paths(paths, select=select)
-    active = [f for f in findings if not f.suppressed]
-    shown = findings if args.show_suppressed else active
-    for f in shown:
-        print(f.format())
-    n_sup = sum(1 for f in findings if f.suppressed)
-    print(f"dalint: {len(active)} finding(s), {n_sup} suppressed, "
-          f"{len(paths)} path(s)")
-    return 1 if active else 0
+    if args.cmd == "lint":
+        return _cmd_lint(args)
+    if args.cmd == "verify-protocols":
+        return _cmd_verify_protocols(args)
+    if args.cmd == "locks":
+        return _cmd_locks(args)
+    parser.print_help()
+    return 2
 
 
 if __name__ == "__main__":

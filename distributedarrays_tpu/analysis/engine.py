@@ -55,15 +55,43 @@ def _codes(group: str) -> set[str]:
     return {c.strip() for c in group.split(",") if c.strip()}
 
 
+def _comment_lines(lines: Sequence[str]) -> dict[int, str] | None:
+    """Map line number -> comment text for REAL comment tokens only, so
+    a docstring that *quotes* the suppression syntax neither silences
+    findings nor trips the DAL100 unused-suppression check.  None when
+    the source can't be tokenized (syntax errors — the caller falls
+    back to the raw-line scan, which can only over-suppress a file the
+    lint run already reports as broken)."""
+    import io
+    import tokenize
+
+    out: dict[int, str] = {}
+    try:
+        toks = tokenize.generate_tokens(
+            io.StringIO("\n".join(lines) + "\n").readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                out.setdefault(tok.start[0], tok.string)
+    except (tokenize.TokenError, SyntaxError, IndentationError,
+            ValueError):
+        return None
+    return out
+
+
 def parse_suppressions(lines: Sequence[str]) -> tuple[dict, set]:
     """Per-line and file-level suppression sets from raw source lines."""
+    comments = _comment_lines(lines)
+    if comments is None:
+        comments = dict(enumerate(lines, 1))
     per_line: dict[int, set[str]] = {}
     whole_file: set[str] = set()
-    for lineno, text in enumerate(lines, 1):
+    for lineno, text in sorted(comments.items()):
         m = _DISABLE_FILE.search(text)
         if m:
             whole_file |= _codes(m.group(1))
-            continue
+            # fall through: a disable-file comment may carry a same-line
+            # disable=DAL100 keeper (docs/analysis.md), and the regexes
+            # cannot cross-match ("disable=" never matches "disable-")
         m = _DISABLE_LINE.search(text)
         if m:
             per_line.setdefault(lineno, set()).update(_codes(m.group(1)))
@@ -94,6 +122,75 @@ def lint_source(src: str, path: str = "<string>",
             out.append(Finding(path, line, col, code, rule.severity,
                                message, suppressed))
     out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def unused_suppressions(src: str, path: str, findings: list[Finding],
+                        checked_codes: Iterable[str] | None = None
+                        ) -> list[Finding]:
+    """Suppression comments that silenced nothing (code ``DAL100``).
+
+    A per-line ``disable=CODE`` is *used* when some finding of that code
+    anchors to that physical line; a ``disable-file=CODE`` when any
+    finding of that code exists in the file.  With a ``--select`` subset
+    active, codes outside ``checked_codes`` are skipped — their rules
+    never ran, so nothing can be concluded.  Codes that name no known
+    rule are always reported (a typo'd suppression protects nothing).
+    ``findings`` must be the UNFILTERED list from :func:`lint_source`
+    (suppressed entries included)."""
+    from . import rules
+
+    lines = src.splitlines()
+    per_line, whole_file = parse_suppressions(lines)
+    checked = set(checked_codes) if checked_codes is not None \
+        else set(rules.RULES)
+    used_line = {(f.line, f.code) for f in findings}
+    used_file = {f.code for f in findings}
+
+    def emit(lineno: int, code: str, text: str) -> Finding:
+        # DAL100 findings accept the ordinary suppression syntax too
+        sup = ("DAL100" in whole_file
+               or "DAL100" in per_line.get(lineno, ()))
+        return Finding(path, lineno, 0, "DAL100", "warning", text, sup)
+
+    out: list[Finding] = []
+    for lineno in sorted(per_line):
+        for code in sorted(per_line[lineno]):
+            if code == "DAL100":
+                continue
+            known = code in rules.RULES
+            if known and code not in checked:
+                continue
+            if not known or (lineno, code) not in used_line:
+                why = ("unknown rule code" if not known
+                       else "no finding of that code on this line")
+                out.append(emit(lineno, code,
+                                f"unused suppression disable={code}: "
+                                f"{why} — remove the comment (or fix "
+                                f"the code if it was a typo)"))
+    # anchor file-level reports at their comment's line so a same-line
+    # disable=DAL100 keeper (docs/analysis.md) can suppress them
+    comments = _comment_lines(lines)
+    if comments is None:
+        comments = dict(enumerate(lines, 1))
+    file_comment_line: dict[str, int] = {}
+    for lineno, text in sorted(comments.items()):
+        m = _DISABLE_FILE.search(text)
+        if m:
+            for code in _codes(m.group(1)):
+                file_comment_line.setdefault(code, lineno)
+    for code in sorted(whole_file):
+        if code == "DAL100":
+            continue
+        known = code in rules.RULES
+        if known and code not in checked:
+            continue
+        if not known or code not in used_file:
+            why = ("unknown rule code" if not known
+                   else "no finding of that code in this file")
+            out.append(emit(file_comment_line.get(code, 1), f"{code}",
+                            f"unused suppression disable-file="
+                            f"{code}: {why} — remove the comment"))
     return out
 
 

@@ -1,0 +1,668 @@
+"""Explicit-state model checker for the RDMA ring-kernel protocols.
+
+``ops/ring_schedules.py`` describes every Pallas ring kernel as
+declarative per-step data (DMA start/wait, semaphore signal/wait, credit
+grant/take, slot read/write with write-once annotations); the Pallas
+emitter replays it on hardware and THIS module replays it under every
+rank-asynchronous interleaving, turning docs/pallas_collectives.md's
+prose proof ("counts balanced exactly so every semaphore drains to
+zero") into a CI gate.  For each schedule it proves:
+
+- **(a) drain**: every semaphore counts zero when all ranks exit;
+- **(b) no slot races**: no region is read or written while a prior DMA
+  into/out of it is still in flight;
+- **(c) write-once**: regions of write-once buffers are written exactly
+  once (the second write errors; the final-token check below catches a
+  missing one);
+- **(d) no starvation**: no reachable state leaves a rank blocked on a
+  wait that can never be satisfied (deadlock detection — programs are
+  finite, so every wait either passes in all explorations or a stuck
+  state is reached and reported);
+- **data correctness**: every read observes the token its schedule
+  expects and the final regions hold the declared results — this is
+  what catches the slot-reuse bug class *even when the late write does
+  not temporally overlap the read* (the exact failure the credits
+  exist to prevent).
+
+Exploration semantics.  Each rank runs its concretized program; remote
+DMAs are pending operations with two nondeterministically-ordered
+completion events (bytes-left → send sem at the source; landed → dst
+write + receive sem at the destination), local copies with one.
+Completions on the **same directed link** (one source rank → one
+destination rank) fire in issue order — ICI delivers per-link
+in-order, and the shipped all-gather's 2-revolving-slot scheme is
+correct *only* under that assumption (an unordered model refutes it
+with a later forward's landing satisfying an earlier slot's wait), so
+in-order delivery is an explicit, documented premise of the proof, not
+an accident of the explorer.  Ranks interact *only* through DMA
+completions and semaphore counts, so rank steps commute with each
+other; the checker therefore advances ranks greedily (completions
+deferred — which maximizes the in-flight windows race detection looks
+for) and branches only over which pending completion fires when every
+rank is blocked, memoizing canonical states.  Completions whose DMA touches regions no other instruction
+ever accesses (the all-to-all direct scatters, every credit grant) are
+fired eagerly: delaying them can only keep the issuing rank's peer
+blocked for longer without enabling any new access, so no behavior is
+lost.  Dually, *local* copies whose src/dst regions only the issuing
+rank ever touches (the reduce-scatter's seed/prefetch/out copies, the
+gather kernels' VMEM loads) are fired as LATE as possible — only when
+their rank blocks on their semaphore, or at exit cleanup when a
+mutant never waits them (the undrained count then fails the drain
+check).  Latest firing is the adversarial schedule for every
+implemented property: it maximizes the in-flight window race
+detection tests, keeps stale tokens visible longest, and cannot mask
+a deadlock (the fire happens exactly when the wait would block) — so
+removing these completions from the global branch set loses no
+violations while collapsing the cross-rank product of their timings.
+Together these reductions keep the ring schedules tractable through
+p = 8 for the windowed kernels.
+
+The **mutation harness** (:func:`mutate`, ``MUTATIONS``) seeds the bug
+class each protocol feature exists for — drop one credit take, drop a
+send-window wait, drop a landing wait — and :func:`verify_protocols`
+requires the checker to refute every applicable mutant with a printed
+interleaving counterexample, proving the gate actually gates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ..ops import ring_schedules as _rs
+
+__all__ = ["CheckResult", "check_schedule", "mutate", "MUTATIONS",
+           "verify_protocols", "format_report", "KERNEL_NAMES",
+           "DEFAULT_PS", "DEFAULT_DEPTHS"]
+
+KERNEL_NAMES = tuple(_rs.SCHEDULES)
+DEFAULT_PS = (2, 3, 4, 5, 8)
+DEFAULT_DEPTHS = (1, 2)
+
+# Exhaustive exploration is exponential in p.  Each kernel is checked
+# at every requested p up to its measured-tractable cap; combinations
+# beyond the cap are SKIPPED AND REPORTED (never silently — the report
+# prints one SKIP line per dropped combo).  Raising --max-states above
+# the default LIFTS the cap (the raised budget is the opt-in; the run
+# then either verifies or fails loudly with a state-budget error):
+# ``--ps 8 --max-states 10000000`` verified ring_allgather_matmul at
+# p=8 exhaustively (2.09M distinct states, OK, ~9 min on one core);
+# the all-to-all's direct scatters reduce to a single canonical
+# interleaving, so it is effectively free at any p.
+DEFAULT_MAX_STATES = 400_000
+P_CAPS = {
+    "ring_all_gather": 6,
+    "ring_all_to_all": 16,
+    "ring_reduce_scatter": 5,
+    "ring_allgather_matmul": 6,
+    "ring_allgather_matmul_rhs": 6,
+    "ring_matmul_reducescatter": 6,
+}
+
+# kernels whose schedule takes a chunk depth
+_CHUNKED = ("ring_all_to_all", "ring_reduce_scatter")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """One schedule × (p, nc) verdict.  ``ok`` means every interleaving
+    satisfied every invariant; otherwise ``kind``/``detail`` name the
+    violated property and ``counterexample`` is the interleaving that
+    reached it (one line per executed instruction or fired DMA
+    completion).  ``states`` counts distinct memoized branch states."""
+
+    name: str
+    p: int
+    nc: int
+    ok: bool
+    kind: str | None = None
+    detail: str | None = None
+    counterexample: list = dataclasses.field(default_factory=list)
+    states: int = 0
+    mutation: str | None = None
+
+
+class _Violation(Exception):
+    def __init__(self, kind: str, detail: str, node):
+        super().__init__(detail)
+        self.kind = kind
+        self.detail = detail
+        self.node = node
+
+
+# ---------------------------------------------------------------------------
+# concretization
+# ---------------------------------------------------------------------------
+
+
+def _fmt_reg(gr) -> str:
+    rank, buf, key = gr
+    inner = ",".join(str(k) for k in key)
+    return f"{buf}[{inner}]@r{rank}" if key else f"{buf}@r{rank}"
+
+
+def _fmt_sem(rank, sem) -> str:
+    return f"{sem[0]}[{sem[1]}]@r{rank}"
+
+
+def _concretize(sched: _rs.Schedule, rank: int):
+    """Evaluate one rank's program: every expression becomes an int,
+    regions become global ``(rank, buf, key)`` triples."""
+    env = {"me": rank, "mod": lambda a, n: a % n}
+    specs = sched.buffer_specs()
+    prog = []
+    for idx, ins in enumerate(sched.program):
+        if isinstance(ins, _rs.Compute):
+            reads = tuple(((rank, b, _rs.ev(k, env)),
+                           _rs.ev(t, env) if t is not None else None)
+                          for ((b, k), t) in ins.reads)
+            writes = tuple(((rank, b, _rs.ev(k, env)), _rs.ev(t, env))
+                           for ((b, k), t) in ins.writes)
+            prog.append(("compute", ins.tag, reads, writes))
+            continue
+        d = ins.dma
+        peer = None if d.peer is None else _rs.ev(d.peer, env)
+        src = (rank, d.src[0], _rs.ev(d.src[1], env))
+        dst = ((peer if peer is not None else rank),
+               d.dst[0], _rs.ev(d.dst[1], env))
+        if isinstance(ins, _rs.Start):
+            cd = (src, dst, d.send, d.recv, peer, d.sem,
+                  _rs.ev(d.token, env) if d.token is not None else None,
+                  _rs.ev(d.src_token, env)
+                  if d.src_token is not None else None)
+            prog.append(("start", (rank, idx), cd))
+        elif isinstance(ins, _rs.WaitSend):
+            prog.append(("wait", d.send, f"send-drain {_fmt_reg(src)}"))
+        elif isinstance(ins, _rs.WaitRecv):
+            sem = d.recv
+            label = ("credit from peer" if d.dst[0] == "cbuf"
+                     else "landing")
+            prog.append(("wait", sem, label))
+        elif isinstance(ins, _rs.WaitLocal):
+            prog.append(("wait", d.sem, f"local copy {_fmt_reg(dst)}"))
+        else:  # pragma: no cover — exhaustive over instruction types
+            raise TypeError(type(ins))
+    final = tuple(((rank, b, _rs.ev(k, env)), _rs.ev(t, env))
+                  for ((b, k), t) in sched.final)
+    return prog, final, specs
+
+
+def _invisible_dmas(progs, specs) -> set:
+    """DMA ids whose src and dst regions no other instruction touches
+    (or whose buffers are credit buffers): their completions commute
+    with every access, so the explorer fires them eagerly."""
+    touch: dict = {}
+    dma_regions: dict = {}
+    for prog in progs:
+        for ins in prog:
+            if ins[0] == "start":
+                _, pid, cd = ins
+                src, dst = cd[0], cd[1]
+                regions = []
+                for gr in (src, dst):
+                    if specs[gr[1]].kind != "credit":
+                        regions.append(gr)
+                        touch.setdefault(gr, set()).add(pid)
+                dma_regions[pid] = regions
+            elif ins[0] == "compute":
+                _, tag, reads, writes = ins
+                for gr, _t in reads + writes:
+                    touch.setdefault(gr, set()).add(("compute", id(ins)))
+    return {pid for pid, regions in dma_regions.items()
+            if all(touch.get(gr, set()) <= {pid} for gr in regions)}
+
+
+# ---------------------------------------------------------------------------
+# the explorer
+# ---------------------------------------------------------------------------
+
+
+class _State:
+    __slots__ = ("pc", "sems", "pending", "tokens", "wcount")
+
+    def __init__(self, p):
+        self.pc = [0] * p
+        self.sems: dict = {}
+        self.pending: dict = {}     # pid -> (rank, cdma, stage)
+        self.tokens: dict = {}
+        self.wcount: dict = {}
+
+    def copy(self):
+        s = _State.__new__(_State)
+        s.pc = list(self.pc)
+        s.sems = dict(self.sems)
+        s.pending = dict(self.pending)
+        s.tokens = dict(self.tokens)
+        s.wcount = dict(self.wcount)
+        return s
+
+    def canon(self):
+        return (tuple(self.pc),
+                frozenset(kv for kv in self.sems.items() if kv[1]),
+                frozenset((pid, st) for pid, (_r, _d, st)
+                          in self.pending.items()),
+                frozenset(self.tokens.items()),
+                frozenset(self.wcount.items()))
+
+
+def _trace(node) -> list:
+    out = []
+    while node is not None:
+        node, text = node
+        out.append(text)
+    out.reverse()
+    return out
+
+
+def check_schedule(sched: _rs.Schedule,
+                   max_states: int = 400_000) -> CheckResult:
+    """Exhaustively explore ``sched`` for all ``sched.p`` ranks; returns
+    the first violation found (with its interleaving) or ok."""
+    p = sched.p
+    nc = dict(sched.params).get("nc", 1)
+    progs, finals = [], []
+    for r in range(p):
+        prog, final, specs = _concretize(sched, r)
+        progs.append(prog)
+        finals.append(final)
+    invisible = _invisible_dmas(progs, specs)
+    credit_bufs = {b for b, sp in specs.items() if sp.kind == "credit"}
+
+    # regions -> ranks whose instructions touch them; local DMAs whose
+    # src+dst are touched by the issuing rank alone are "private": their
+    # completion interleaves only with that (sequential) rank, so it is
+    # fired at the latest possible point instead of branched globally
+    region_ranks: dict = {}
+    for rr, prog in enumerate(progs):
+        for ins in prog:
+            if ins[0] == "start":
+                for gr in (ins[2][0], ins[2][1]):
+                    region_ranks.setdefault(gr, set()).add(rr)
+            elif ins[0] == "compute":
+                for gr, _t in ins[2] + ins[3]:
+                    region_ranks.setdefault(gr, set()).add(rr)
+    private_local: set = set()
+    for rr, prog in enumerate(progs):
+        for ins in prog:
+            if ins[0] != "start" or ins[2][4] is not None:
+                continue
+            pid, (src, dst) = ins[1], (ins[2][0], ins[2][1])
+            if pid in invisible:
+                continue
+            if region_ranks.get(src, set()) <= {rr} and \
+                    region_ranks.get(dst, set()) <= {rr}:
+                private_local.add(pid)
+
+    def inflight(state, gr, *, skip=None):
+        """Pending DMAs reading/writing global region ``gr``."""
+        reads, writes = [], []
+        for pid, (rank, cd, stage) in state.pending.items():
+            if pid == skip:
+                continue
+            src, dst, _send, _recv, peer, _sem = cd[:6]
+            if src == gr and (peer is None or stage < 1):
+                reads.append(pid)
+            if dst == gr:
+                writes.append(pid)
+        return reads, writes
+
+    def check_read(state, gr, expect, who, node):
+        if gr[1] in credit_bufs:
+            return
+        _r, w = inflight(state, gr)
+        if w:
+            raise _Violation(
+                "race", f"{who} reads {_fmt_reg(gr)} while DMA "
+                f"{w[0]} is still landing into it", node)
+        if expect is not None:
+            got = state.tokens.get(gr, "<unwritten>")
+            if got != expect:
+                raise _Violation(
+                    "stale-read",
+                    f"{who} reads {_fmt_reg(gr)} expecting {expect} "
+                    f"but the slot holds {got} — slot reused before "
+                    f"its consumer was done", node)
+
+    def check_write(state, gr, who, node):
+        if gr[1] in credit_bufs:
+            return
+        r, w = inflight(state, gr)
+        if r or w:
+            other = (r or w)[0]
+            raise _Violation(
+                "race", f"{who} writes {_fmt_reg(gr)} while DMA "
+                f"{other} into/out of it is still in flight", node)
+        spec = specs[gr[1]]
+        if spec.write_once:
+            n = state.wcount.get(gr, 0) + 1
+            state.wcount[gr] = n
+            if n > 1:
+                raise _Violation(
+                    "write-once",
+                    f"{who}: write-once region {_fmt_reg(gr)} written "
+                    f"{n} times", node)
+
+    def fire(state, pid, node):
+        rank, cd, stage = state.pending[pid]
+        src, dst, send, recv, peer, sem, token, _st = cd
+        if peer is None:
+            if dst[1] not in credit_bufs:
+                state.tokens[dst] = token
+            key = (rank,) + sem
+            state.sems[key] = state.sems.get(key, 0) + 1
+            del state.pending[pid]
+            return (node, f"  · local copy r{rank}#{pid[1]} done "
+                          f"→ {_fmt_reg(dst)}")
+        if stage == 0:
+            key = (rank,) + send
+            state.sems[key] = state.sems.get(key, 0) + 1
+            state.pending[pid] = (rank, cd, 1)
+            return (node, f"  · dma r{rank}#{pid[1]} bytes left "
+                          f"({_fmt_sem(rank, send)} +1)")
+        if dst[1] not in credit_bufs:
+            state.tokens[dst] = token
+        key = (dst[0],) + recv
+        state.sems[key] = state.sems.get(key, 0) + 1
+        del state.pending[pid]
+        return (node, f"  · dma r{rank}#{pid[1]} landed at "
+                      f"{_fmt_reg(dst)} ({_fmt_sem(dst[0], recv)} +1)")
+
+    def fireable(state, pid):
+        """Per-link FIFO (ICI in-order delivery): a bytes-left event
+        needs every earlier-issued same-link DMA past stage 0; a landing
+        needs them all fully landed.  A rank issues its program in
+        order, so same-link issue order IS program-index order (pids
+        are ``(rank, idx)``).  Local copies are unordered."""
+        rank, cd, stage = state.pending[pid]
+        peer = cd[4]
+        if peer is None:
+            return True
+        for pid2, (r2, cd2, st2) in state.pending.items():
+            if r2 != rank or cd2[4] != peer or pid2[1] >= pid[1]:
+                continue
+            if stage == 1 or st2 == 0:
+                return False
+        return True
+
+    def execute(state, r, ins, node):
+        if ins[0] == "wait":
+            _w, sem, label = ins
+            key = (r,) + sem
+            state.sems[key] = state.sems[key] - 1
+            return (node, f"r{r}: wait {_fmt_sem(r, sem)} ({label})")
+        if ins[0] == "start":
+            _s, pid, cd = ins
+            src, dst, send, recv, peer, sem, token, src_token = cd
+            who = f"r{r}#{pid[1]} start"
+            desc = (f"r{r}: start {'copy' if peer is None else 'dma'} "
+                    f"{_fmt_reg(src)} → {_fmt_reg(dst)}")
+            node = (node, desc)
+            check_read(state, src, src_token, who, node)
+            check_write(state, dst, who, node)
+            state.pending[pid] = (r, cd, 0)
+            return node
+        _c, tag, reads, writes = ins
+        who = f"r{r} {tag}"
+        desc = (f"r{r}: {tag}({', '.join(_fmt_reg(g) for g, _ in reads)})"
+                f" → {', '.join(_fmt_reg(g) for g, _ in writes)}")
+        node = (node, desc)
+        for gr, expect in reads:
+            check_read(state, gr, expect, who, node)
+        for gr, token in writes:
+            check_write(state, gr, who, node)
+            state.tokens[gr] = token
+        return node
+
+    def enabled(state, r):
+        if state.pc[r] >= len(progs[r]):
+            return None
+        ins = progs[r][state.pc[r]]
+        if ins[0] == "wait" and state.sems.get((r,) + ins[1], 0) < 1:
+            return None
+        return ins
+
+    def unblock_private(state, r, node):
+        """If rank ``r`` is blocked on a semaphore one of its own
+        pending private-local copies signals, fire that copy (latest
+        possible firing — see module docstring); None if not."""
+        ins = progs[r][state.pc[r]]
+        if ins[0] != "wait":
+            return None
+        want = (r,) + ins[1]
+        for pid in sorted(state.pending):
+            if pid in private_local and pid[0] == r:
+                rank, cd, _stage = state.pending[pid]
+                if (rank,) + cd[5] == want:
+                    return fire(state, pid, node)
+        return None
+
+    def greedy(state, node):
+        """Advance deterministically: fire invisible completions, run
+        every rank until it blocks.  Rank steps commute across ranks and
+        deferring visible completions only widens the in-flight windows,
+        so this loses no violations (see module docstring)."""
+        changed = True
+        while changed:
+            changed = False
+            for pid in sorted(state.pending):
+                if pid in invisible and fireable(state, pid):
+                    node = fire(state, pid, node)
+                    changed = True
+            for r in range(p):
+                while True:
+                    ins = enabled(state, r)
+                    if ins is None:
+                        if state.pc[r] < len(progs[r]):
+                            nn = unblock_private(state, r, node)
+                            if nn is not None:
+                                node = nn
+                                changed = True
+                                continue
+                        break
+                    node = execute(state, r, ins, node)
+                    state.pc[r] += 1
+                    changed = True
+        return node
+
+    def finals_check(state, node):
+        bad = [k for k, v in state.sems.items() if v]
+        if bad:
+            k = sorted(bad)[0]
+            raise _Violation(
+                "drain", f"semaphore {_fmt_sem(k[0], k[1:])} holds "
+                f"{state.sems[k]} undrained signal(s) at exit "
+                f"({len(bad)} semaphore(s) nonzero)", node)
+        for r in range(p):
+            for gr, expect in finals[r]:
+                got = state.tokens.get(gr, "<unwritten>")
+                if got != expect:
+                    raise _Violation(
+                        "final", f"at exit {_fmt_reg(gr)} holds {got}, "
+                        f"expected {expect}", node)
+                if specs[gr[1]].write_once and \
+                        state.wcount.get(gr, 0) != 1:
+                    raise _Violation(
+                        "write-once", f"write-once region {_fmt_reg(gr)} "
+                        f"written {state.wcount.get(gr, 0)} times "
+                        f"(expected exactly once)", node)
+
+    init = _State(p)
+    stack = [(init, None)]
+    seen: set = {init.canon()}
+    states = 0
+    try:
+        while stack:
+            state, node = stack.pop()
+            states += 1
+            if states > max_states:
+                raise _Violation(
+                    "state-budget",
+                    f"exploration exceeded {max_states} states — raise "
+                    f"max_states or reduce p/chunks", node)
+            node = greedy(state, node)
+            while state.pending and all(
+                    pid in private_local for pid in state.pending):
+                # leftovers a mutant never waits on: fire at exit so the
+                # undrained signal fails the drain check, then let any
+                # newly-enabled rank run
+                for pid in sorted(state.pending):
+                    node = fire(state, pid, node)
+                node = greedy(state, node)
+            if not state.pending:
+                if all(state.pc[r] >= len(progs[r]) for r in range(p)):
+                    finals_check(state, node)
+                    continue
+                blocked = [
+                    (r, progs[r][state.pc[r]])
+                    for r in range(p) if state.pc[r] < len(progs[r])]
+                r, ins = blocked[0]
+                raise _Violation(
+                    "starvation",
+                    f"deadlock: {len(blocked)} rank(s) blocked forever; "
+                    f"rank {r} waits on {_fmt_sem(r, ins[1])} "
+                    f"({ins[2]}) with no completion left to signal it",
+                    node)
+            for pid in sorted(state.pending):
+                if pid in private_local or not fireable(state, pid):
+                    continue
+                nxt = state.copy()
+                nnode = fire(nxt, pid, node)
+                # memoize the post-fire state: greedy() is a
+                # deterministic function of it, so duplicates are
+                # pruned before paying the greedy closure
+                key = nxt.canon()
+                if key in seen:
+                    continue
+                seen.add(key)
+                stack.append((nxt, nnode))
+    except _Violation as v:
+        return CheckResult(sched.name, p, nc, False, v.kind, v.detail,
+                           _trace(v.node), states)
+    return CheckResult(sched.name, p, nc, True, states=states)
+
+
+# ---------------------------------------------------------------------------
+# mutation harness
+# ---------------------------------------------------------------------------
+
+# each mutation seeds the bug class a protocol feature exists to
+# exclude; ``mutate`` returns None when the schedule has no such
+# instruction (e.g. no credits in the all-gather)
+MUTATIONS = ("drop-credit-take", "drop-send-wait", "drop-recv-wait")
+
+
+def mutate(sched: _rs.Schedule, mutation: str) -> _rs.Schedule | None:
+    """Remove the first instruction of the mutated class; None when the
+    schedule has none.  The checker must refute every non-None mutant."""
+    def match(ins):
+        if mutation == "drop-credit-take":
+            return (isinstance(ins, _rs.WaitRecv)
+                    and ins.dma.recv[0] == "crecv")
+        if mutation == "drop-send-wait":
+            return (isinstance(ins, _rs.WaitSend)
+                    and ins.dma.send[0] == "send")
+        if mutation == "drop-recv-wait":
+            return (isinstance(ins, _rs.WaitRecv)
+                    and ins.dma.recv[0] == "recv")
+        raise ValueError(f"unknown mutation {mutation!r}")
+
+    prog = list(sched.program)
+    for i, ins in enumerate(prog):
+        if match(ins):
+            del prog[i]
+            return dataclasses.replace(
+                sched, name=f"{sched.name}!{mutation}",
+                program=tuple(prog))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+
+def verify_protocols(ps=DEFAULT_PS, depths=DEFAULT_DEPTHS, *,
+                     mutants: bool = True, mutant_p: int = 4,
+                     max_states: int = DEFAULT_MAX_STATES) -> dict:
+    """Check every shipped ring-kernel schedule over ``ps`` × ``depths``
+    (chunkless kernels run once per p), then require the checker to
+    refute every applicable mutant (seeded at ``mutant_p``, chunk depth
+    2 for the chunked kernels so the credit path is armed).  Returns
+    ``{"ok", "kernels": [CheckResult...], "mutants": [CheckResult...]}``
+    — ``ok`` is True iff all genuine schedules verify AND every mutant
+    is caught."""
+    kernels: list[CheckResult] = []
+    skipped: list[tuple] = []
+    for name in KERNEL_NAMES:
+        ncs = tuple(depths) if name in _CHUNKED else (1,)
+        for p in ps:
+            if (p > P_CAPS.get(name, max(ps))
+                    and max_states <= DEFAULT_MAX_STATES):
+                # a raised --max-states lifts the cap: the bigger
+                # budget is the deep-run opt-in, and check_schedule
+                # fails loudly (state-budget) if it still isn't enough
+                skipped.append((name, p, P_CAPS[name]))
+                continue
+            for nc in ncs:
+                sched = _rs.build(name, p, nc)
+                kernels.append(check_schedule(sched,
+                                              max_states=max_states))
+    mutant_results: list[CheckResult] = []
+    if mutants:
+        for name in KERNEL_NAMES:
+            nc = 2 if name in _CHUNKED else 1
+            sched = _rs.build(name, mutant_p, nc)
+            for mutation in MUTATIONS:
+                m = mutate(sched, mutation)
+                if m is None:
+                    continue
+                res = check_schedule(m, max_states=max_states)
+                res.mutation = mutation
+                mutant_results.append(res)
+    ok = (all(r.ok for r in kernels)
+          and all(not r.ok and r.kind != "state-budget"
+                  for r in mutant_results))
+    return {"ok": ok, "kernels": kernels, "mutants": mutant_results,
+            "skipped": skipped}
+
+
+def format_report(report: dict, *, verbose_counterexamples: bool = True,
+                  max_trace_lines: int = 40) -> str:
+    """Human-readable report: one line per schedule verdict; refuted
+    mutants print the violated invariant and (optionally) the
+    interleaving counterexample the checker found."""
+    lines = []
+    for r in report["kernels"]:
+        tag = "OK " if r.ok else "FAIL"
+        lines.append(f"{tag} {r.name} p={r.p} nc={r.nc} "
+                     f"({r.states} states)")
+        if not r.ok:
+            lines.append(f"     {r.kind}: {r.detail}")
+            for t in r.counterexample[-max_trace_lines:]:
+                lines.append(f"     | {t}")
+    for r in report["mutants"]:
+        caught = not r.ok and r.kind != "state-budget"
+        tag = "CAUGHT " if caught else "MISSED "
+        lines.append(f"{tag} {r.name} p={r.p} nc={r.nc} "
+                     f"({r.states} states)")
+        if caught:
+            lines.append(f"     {r.kind}: {r.detail}")
+            if verbose_counterexamples:
+                trace = r.counterexample
+                if len(trace) > max_trace_lines:
+                    lines.append(f"     | ... "
+                                 f"({len(trace) - max_trace_lines} "
+                                 f"earlier step(s) elided)")
+                    trace = trace[-max_trace_lines:]
+                for t in trace:
+                    lines.append(f"     | {t}")
+    for name, p, cap in report.get("skipped", ()):
+        lines.append(f"SKIP {name} p={p} — exceeds the tractable "
+                     f"exhaustive cap ({cap}); deep-run with "
+                     f"--ps {p} --max-states 10000000")
+    lines.append("protocol verification: "
+                 + ("OK" if report["ok"] else "FAILED")
+                 + f" ({len(report['kernels'])} schedule(s), "
+                 f"{len(report['mutants'])} mutant(s), "
+                 f"{len(report.get('skipped', ()))} combo(s) skipped)")
+    return "\n".join(lines)

@@ -26,6 +26,16 @@ has one bad/good pair per rule):
           ``parallel/reshard.py`` — whole-array eager moves bypass the
           reshard planner (plan cache, chunked collective lowering,
           moved-bytes accounting); route through ``parallel.reshard``.
+- DAL008  blocking call (queue put/get, event/condition wait, thread
+          join, sleep, eager SPMD receive, subprocess wait) made while
+          holding a lock — every contender on that lock now waits on
+          the blocker's condition too; the PR 7 submit/drain bug class
+          (engine: ``analysis/locks.py``, interprocedural).
+- DAL009  lock-order cycle in the acquisition graph (ABBA deadlock) or
+          a non-reentrant ``threading.Lock`` re-acquired while held
+          (the SIGTERM-handler self-deadlock shape); cross-file cycles
+          surface via ``python -m distributedarrays_tpu.analysis
+          locks``.
 
 Rules are conservative by design: a rule that cannot prove its premise
 (axis bound elsewhere, value not traced, ...) stays silent.  Intentional
@@ -698,3 +708,37 @@ def _check_dal007(tree, path, lines):
                "parallel.reshard.reshard(x, sharding) — or suppress "
                "with a justification if this site cannot have a "
                "plannable source layout")
+
+
+# ---------------------------------------------------------------------------
+# DAL008/DAL009 — lock-order and blocking-under-lock (analysis/locks.py)
+# ---------------------------------------------------------------------------
+
+# The real engine lives in ``analysis/locks.py`` (it is interprocedural
+# and also runs cross-file via the ``locks`` CLI verb); the rule
+# catalog exposes its single-file mode so the ordinary lint sweep and
+# the usual suppression syntax apply.  Single-file mode still resolves
+# ``self.method()`` / module-function calls within the file, so a
+# helper that sleeps three calls deep is caught from the locked caller.
+
+
+def _lock_findings(tree, path, lines, code):
+    # re-serialize from the lines the engine parsed: locks.py caches per
+    # (path, source), so the two rule codes share one analysis pass
+    from . import locks as _locks
+    src = "\n".join(lines)
+    for f in _locks.findings_for_source(src, path):
+        if f.code == code:
+            yield (f.line, f.col, f.message)
+
+
+@_rule("DAL008", "warning",
+       "blocking call made while holding a lock")
+def _check_dal008(tree, path, lines):
+    yield from _lock_findings(tree, path, lines, "DAL008")
+
+
+@_rule("DAL009", "warning",
+       "lock-order cycle / non-reentrant re-acquisition (deadlock)")
+def _check_dal009(tree, path, lines):
+    yield from _lock_findings(tree, path, lines, "DAL009")

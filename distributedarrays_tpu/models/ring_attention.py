@@ -168,7 +168,12 @@ def _rdma_attn_call(axis, p, b, h, dh, dtype_str, causal, scale, qblk,
             s = t % 2
             src = _pc._mod(me - t, p)        # resident block's origin
             if t < p - 1:
-                if t >= 2:
+                # credit window arms at t == 1, mirroring the
+                # checker-proven _ag_gemm_prog window (ring_schedules):
+                # the step-t forward writes the slot the lagging right
+                # neighbor's step-(t-1) attention compute still reads,
+                # so every forward after the first must take a credit
+                if t >= 1:
                     credit.take(right)       # right freed the slot we hit
                 fwd = pltpu.make_async_remote_copy(
                     src_ref=kv.at[s], dst_ref=kv.at[1 - s],
@@ -206,7 +211,7 @@ def _rdma_attn_call(axis, p, b, h, dh, dtype_str, causal, scale, qblk,
                 m_ref[:, r0:r0 + qblk] = m_new
             if t < p - 1:
                 fwd.wait()
-                if 1 <= t <= p - 3:          # balance against the takes
+                if t <= p - 3:               # balance against the takes
                     credit.grant(left)
         ll = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
         out = (acc[...] / ll[:, :, None]).astype(dtype)

@@ -48,6 +48,16 @@ has the worked schedule diagrams):
   semaphore can accumulate several landings and be drained with one
   descriptor wait per landing, in any order.
 
+Every kernel body is **emitted from a declarative schedule**
+(``ops/ring_schedules.py``): the per-step DMA starts, semaphore waits,
+credit grants/takes, and compute steps are data, interpreted at trace
+time by :func:`_emit` (regions → ref slices, sems → DMA-semaphore
+scratch) and exhaustively model-checked by ``analysis.protocol`` — the
+emitter and the checker share one source of truth, so the semaphore
+protocol documented in docs/pallas_collectives.md is machine-verified,
+not hand-argued (``python -m distributedarrays_tpu.analysis
+verify-protocols``).
+
 Dispatch (mirrors ``pallas_gemm``'s ``pltpu is None`` guard): the RDMA
 kernels run compiled on real TPUs and in interpreter mode when forced
 (tests, ``DA_TPU_RDMA=interpret``); every other platform falls back to
@@ -83,6 +93,7 @@ except Exception:  # pragma: no cover
 from .. import telemetry as _tm
 from ..parallel.collectives import (axis_size as _axis_size, pall_to_all,
                                     pgather)
+from . import ring_schedules as _rs
 
 __all__ = ["rdma_mode", "resolve_chunks", "ring_all_gather",
            "ring_reduce_scatter", "ring_all_to_all",
@@ -201,7 +212,11 @@ class _Credit:
     """The 4-byte flow-control grant: ``grant(to)`` DMAs one credit to a
     neighbor; ``take(frm)`` blocks until one credit has landed here.
     Contents are irrelevant (only the receive semaphore's count matters);
-    concurrent grants into the same buffer are harmless."""
+    concurrent grants into the same buffer are harmless.  The six ring
+    kernels get their credits from the declarative schedules; this
+    helper remains for the fused ring-attention kernel
+    (``models/ring_attention``), whose blockwise-softmax compute is not
+    schedule-emitted yet."""
 
     def __init__(self, buf_ref, send_sem, recv_sem):
         self.buf, self.ssem, self.rsem = buf_ref, send_sem, recv_sem
@@ -226,6 +241,52 @@ def _credit_scratch():
             pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA]
 
 
+def _emit(sched, me, regions, sems, computes=None):
+    """Replay a :class:`ring_schedules.Schedule` as Pallas DMA ops.
+
+    ``regions`` maps buffer name → ``fn(key) -> ref slice`` (the
+    kernel's geometry — keys arrive with rank expressions already
+    evaluated to traced values); ``sems`` maps sem name → scratch ref;
+    ``computes`` maps compute tag → ``fn(args dict)``.  Wait
+    instructions rebuild an equal-shaped descriptor from their template
+    DMA, the same same-size-drains-one semantics the hand-rolled
+    kernels used.  Credit grants/takes arrive as ordinary
+    start/wait-send/wait-recv instructions over the ``cbuf`` buffer."""
+    env = {"me": me, "mod": _mod}
+    slots = sched.sem_slots()
+
+    def reg(r):
+        buf, key = r
+        return regions[buf](_rs.ev(key, env))
+
+    def sref(sm):
+        name, idx = sm
+        ref = sems[name]
+        return ref.at[idx] if slots[name] else ref
+
+    def desc(d):
+        if d.peer is None:
+            return pltpu.make_async_copy(reg(d.src), reg(d.dst),
+                                         sref(d.sem))
+        return pltpu.make_async_remote_copy(
+            src_ref=reg(d.src), dst_ref=reg(d.dst),
+            send_sem=sref(d.send), recv_sem=sref(d.recv),
+            device_id=_rs.ev(d.peer, env),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    for ins in sched.program:
+        if isinstance(ins, _rs.Start):
+            desc(ins.dma).start()
+        elif isinstance(ins, _rs.WaitSend):
+            desc(ins.dma).wait_send()
+        elif isinstance(ins, _rs.WaitRecv):
+            desc(ins.dma).wait_recv()
+        elif isinstance(ins, _rs.WaitLocal):
+            desc(ins.dma).wait()
+        else:
+            computes[ins.tag]({k: _rs.ev(v, env) for k, v in ins.args})
+
+
 # ---------------------------------------------------------------------------
 # ring all-gather
 # ---------------------------------------------------------------------------
@@ -240,45 +301,13 @@ def _ag_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
     out_shape = tuple(blk * p if d == dim else s
                       for d, s in enumerate(shape))
 
+    sched = _rs.all_gather_schedule(p)
+
     def kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem):
-        me = lax.axis_index(axis)
-        right = _mod(me + 1, p)
-
-        def blk_at(ref, i):
-            return _ds_at(ref, dim, i * blk, blk, ndim)
-
-        # local block straight to its output slot; must land before the
-        # first forward reads it
-        _copy(x_ref, blk_at(o_ref, me), copy_sem)
-        for t in range(p - 1):
-            src = _mod(me - t, p)            # block received at step t-1
-            s = t % 2
-            fwd = pltpu.make_async_remote_copy(
-                src_ref=blk_at(o_ref, src), dst_ref=blk_at(o_ref, src),
-                send_sem=send_sem.at[s], recv_sem=recv_sem.at[s],
-                device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
-            if t >= 2:
-                # consume the step t-2 send on this semaphore slot before
-                # reusing it (equal sizes: any same-shaped descriptor
-                # drains exactly one forward)
-                fwd.wait_send()
-            fwd.start()
-            # the incoming block (me - t - 1) — left's step-t forward —
-            # rides the wire while ours drains; wait for it so the next
-            # step may forward it on
-            inc = _mod(me - t - 1, p)
-            pltpu.make_async_remote_copy(
-                src_ref=blk_at(o_ref, inc), dst_ref=blk_at(o_ref, inc),
-                send_sem=send_sem.at[s], recv_sem=recv_sem.at[s],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_recv()
-        # drain the last (up to) two in-flight sends
-        for t in range(max(p - 3, 0), p - 1):
-            pltpu.make_async_remote_copy(
-                src_ref=blk_at(o_ref, me), dst_ref=blk_at(o_ref, me),
-                send_sem=send_sem.at[t % 2], recv_sem=recv_sem.at[t % 2],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_send()
+        _emit(sched, lax.axis_index(axis), regions={
+            "x": lambda k: x_ref,
+            "out": lambda k: _ds_at(o_ref, dim, k[0] * blk, blk, ndim),
+        }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem})
 
     return pl.pallas_call(
         kernel,
@@ -337,57 +366,27 @@ def _a2a_call(axis: str, p: int, shape: tuple, dtype_str: str,
     cext = shape[concat_dim]
     nc = _chunk_fit(cext, nchunks)
     piece = cext // nc
-    # destination distances, bidirectionally interleaved so both ICI link
-    # directions carry traffic: +1, -1, +2, -2, ...
-    offs = []
-    for s in range(1, p // 2 + 1):
-        offs.append(s)
-        if s != p - s:
-            offs.append(p - s)
+    sched = _rs.all_to_all_schedule(p, nc)
 
     def kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem):
-        me = lax.axis_index(axis)
+        def x_reg(k):
+            # (dst, c) piece, or (me, "all") — the whole resident block
+            if k[1] == "all":
+                return _ds_at(x_ref, split_dim, k[0] * sblk, sblk, ndim)
+            r = _ds_at(x_ref, split_dim, k[0] * sblk, sblk, ndim)
+            return _ds_at(r, concat_dim, k[1] * piece, piece, ndim)
 
-        def src_slc(ref, dst, c):
-            r = _ds_at(ref, split_dim, dst * sblk, sblk, ndim)
-            return _ds_at(r, concat_dim, c * piece, piece, ndim)
+        def o_reg(k):
+            # keyed by the SENDER's rank: its piece lands at its own
+            # concat offset of the destination's output
+            if k[1] == "all":
+                return _ds_at(o_ref, concat_dim, k[0] * cext, cext, ndim)
+            return _ds_at(o_ref, concat_dim, k[0] * cext + k[1] * piece,
+                          piece, ndim)
 
-        def dst_slc(ref, c):
-            # my piece lands at MY rank's concat offset in the peer's out
-            r = _ds_at(ref, concat_dim, me * cext + c * piece, piece, ndim)
-            return r
-
-        # the resident piece moves locally
-        _copy(_ds_at(x_ref, split_dim, me * sblk, sblk, ndim),
-              _ds_at(o_ref, concat_dim, me * cext, cext, ndim), copy_sem)
-        k = 0
-        for off in offs:
-            dst = _mod(me + off, p)
-            for c in range(nc):
-                d = pltpu.make_async_remote_copy(
-                    src_ref=src_slc(x_ref, dst, c),
-                    dst_ref=dst_slc(o_ref, c),
-                    send_sem=send_sem.at[k % 2], recv_sem=recv_sem,
-                    device_id=dst,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
-                if k >= 2:
-                    d.wait_send()            # free the revolving send slot
-                d.start()
-                k += 1
-        # drain sends, then the (p-1)*nc equal-sized landings — the
-        # receive semaphore accumulates them in any order
-        for k in range(max(k - 2, 0), k):
-            pltpu.make_async_remote_copy(
-                src_ref=src_slc(x_ref, me, 0), dst_ref=dst_slc(o_ref, 0),
-                send_sem=send_sem.at[k % 2], recv_sem=recv_sem,
-                device_id=me,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_send()
-        for _ in range((p - 1) * nc):
-            pltpu.make_async_remote_copy(
-                src_ref=src_slc(x_ref, me, 0), dst_ref=dst_slc(o_ref, 0),
-                send_sem=send_sem.at[0], recv_sem=recv_sem,
-                device_id=me,
-                device_id_type=pltpu.DeviceIdType.LOGICAL).wait_recv()
+        _emit(sched, lax.axis_index(axis),
+              regions={"x": x_reg, "out": o_reg},
+              sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem})
 
     return pl.pallas_call(
         kernel,
@@ -467,50 +466,32 @@ def _rs_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
     piece = tuple(s // nc if d == cax else s
                   for d, s in enumerate(out_shape))
 
+    sched = _rs.reduce_scatter_schedule(p, nc)
+
     def kernel(x_ref, o_ref, recv, acc, tmp, send_sem, recv_sem, copy_sem,
                tmp_sem, cbuf, csend, crecv):
-        me = lax.axis_index(axis)
-        right = _mod(me + 1, p)
-        left = _mod(me - 1, p)
-        credit = _Credit(cbuf, csend, crecv)
-
-        def x_piece(b, c):
+        def x_piece(k):
+            b, c = k
             r = _ds_at(x_ref, dim, b * oblk, oblk, ndim)
             # nc == 1 keeps the block slice whole (also avoids chaining
             # two slices on the same axis when ndim == 1 forces cax==dim)
             return r if nc == 1 else _ds_at(r, cax, c * piece[cax],
                                             piece[cax], ndim)
 
-        for c in range(nc):
-            if c >= 1:
-                # right must have consumed its chunk c-1 receive slots
-                # before this chunk's partials land in them
-                credit.take(right)
-            # seed: the partial destined (p-1) hops away starts here
-            _copy(x_piece(_mod(me - 1, p), c), acc.at[0], copy_sem)
-            a = 0
-            for t in range(p - 1):
-                d = pltpu.make_async_remote_copy(
-                    src_ref=acc.at[a], dst_ref=recv.at[t],
-                    send_sem=send_sem.at[a], recv_sem=recv_sem.at[t],
-                    device_id=right,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
-                d.start()
-                # prefetch the next local contribution while the partial
-                # rides the ring
-                nb = _mod(me - t - 2, p)
-                cp = pltpu.make_async_copy(x_piece(nb, c), tmp.at[a],
-                                           tmp_sem.at[a])
-                cp.start()
-                d.wait()                     # send drained + left's landed
-                cp.wait()
-                acc[1 - a] = recv[t] + tmp[a]
-                a = 1 - a
-            # chunk consumed: grant left one more chunk of credit
-            if c < nc - 1:
-                credit.grant(left)
-            out = _ds_at(o_ref, cax, c * piece[cax], piece[cax], ndim)
-            _copy(acc.at[a], out, copy_sem)
+        def accum(a):
+            acc[1 - a["a"]] = recv[a["t"]] + tmp[a["a"]]
+
+        _emit(sched, lax.axis_index(axis), regions={
+            "x": x_piece,
+            "acc": lambda k: acc.at[k[0]],
+            "recv": lambda k: recv.at[k[0]],
+            "tmp": lambda k: tmp.at[k[0]],
+            "out": lambda k: _ds_at(o_ref, cax, k[0] * piece[cax],
+                                    piece[cax], ndim),
+            "cbuf": lambda k: cbuf,
+        }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem,
+                 "tmp": tmp_sem, "csend": csend, "crecv": crecv},
+            computes={"accum": accum})
 
     return pl.pallas_call(
         kernel,
@@ -608,37 +589,25 @@ def _ag_mm_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
     dtype = jnp.dtype(dtype_str)
     out_dtype = jnp.dtype(out_dtype_str)
 
+    sched = _rs.ag_matmul_schedule(p)
+
     def kernel(x_ref, w_ref, o_ref, buf, send_sem, recv_sem, copy_sem,
                cbuf, csend, crecv):
-        me = lax.axis_index(axis)
-        left = _mod(me - 1, p)
-        right = _mod(me + 1, p)
-        credit = _Credit(cbuf, csend, crecv)
-        _copy(x_ref, buf.at[0], copy_sem)
-        for t in range(p):
-            s = t % 2
-            # the lax path's schedule: resident chunk originated at rank
-            # me + t (pshift(-1) = fetch from the right neighbor)
-            src = _mod(me + t, p)
-            if t < p - 1:
-                if t >= 2:
-                    credit.take(left)        # left freed the slot we hit
-                fwd = pltpu.make_async_remote_copy(
-                    src_ref=buf.at[s], dst_ref=buf.at[1 - s],
-                    send_sem=send_sem.at[s], recv_sem=recv_sem.at[1 - s],
-                    device_id=left,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
-                fwd.start()
-            # resident chunk multiplies while the forward is in flight
-            o_ref[pl.ds(src * m_loc, m_loc)] = jnp.dot(
-                buf[s], w_ref[...],
+        def dot(a):
+            # resident chunk multiplies while the forward is in flight;
+            # resident chunk originated at rank me + t (the lax path's
+            # pshift(-1) = fetch-from-the-right schedule)
+            o_ref[pl.ds(a["src"] * m_loc, m_loc)] = jnp.dot(
+                buf[a["s"]], w_ref[...],
                 preferred_element_type=jnp.float32).astype(out_dtype)
-            if t < p - 1:
-                fwd.wait()
-                if 1 <= t <= p - 3:
-                    # slot s consumed; balance exactly against the
-                    # takes (sems must drain to zero at kernel exit)
-                    credit.grant(right)
+
+        _emit(sched, lax.axis_index(axis), regions={
+            "xin": lambda k: x_ref,
+            "buf": lambda k: buf.at[k[0]],
+            "cbuf": lambda k: cbuf,
+        }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem,
+                 "csend": csend, "crecv": crecv},
+            computes={"dot": dot})
 
     return pl.pallas_call(
         kernel,
@@ -684,38 +653,29 @@ def _ag_mm_rhs_call(axis: str, p: int, as_: tuple, bs: tuple,
     dtype = jnp.dtype(dtype_str)
     out_dtype = jnp.dtype(out_dtype_str)
 
+    sched = _rs.ag_matmul_rhs_schedule(p)
+
     def kernel(a_ref, b_ref, o_ref, buf, send_sem, recv_sem, copy_sem,
                cbuf, csend, crecv):
-        me = lax.axis_index(axis)
-        left = _mod(me - 1, p)
-        right = _mod(me + 1, p)
-        credit = _Credit(cbuf, csend, crecv)
-        _copy(b_ref, buf.at[0], copy_sem)
-        for t in range(p):
-            s = t % 2
-            src = _mod(me + t, p)
-            if t < p - 1:
-                if t >= 2:
-                    credit.take(left)
-                fwd = pltpu.make_async_remote_copy(
-                    src_ref=buf.at[s], dst_ref=buf.at[1 - s],
-                    send_sem=send_sem.at[s], recv_sem=recv_sem.at[1 - s],
-                    device_id=left,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
-                fwd.start()
+        def accum_rhs(a):
             # resident chunk contracts against its column slice of a —
             # cast per step like the lax path's ``part``
-            part = jnp.dot(a_ref[:, pl.ds(src * k_loc, k_loc)], buf[s],
+            part = jnp.dot(a_ref[:, pl.ds(a["src"] * k_loc, k_loc)],
+                           buf[a["s"]],
                            preferred_element_type=jnp.float32
                            ).astype(out_dtype)
-            if t == 0:
+            if a["t"] == 0:
                 o_ref[...] = part
             else:
                 o_ref[...] = o_ref[...] + part
-            if t < p - 1:
-                fwd.wait()
-                if 1 <= t <= p - 3:          # balance against the takes
-                    credit.grant(right)
+
+        _emit(sched, lax.axis_index(axis), regions={
+            "xin": lambda k: b_ref,
+            "buf": lambda k: buf.at[k[0]],
+            "cbuf": lambda k: cbuf,
+        }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem,
+                 "csend": csend, "crecv": crecv},
+            computes={"accum_rhs": accum_rhs})
 
     return pl.pallas_call(
         kernel,
@@ -760,42 +720,37 @@ def _mm_rs_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
     m_loc = m // p
     dtype = jnp.dtype(dtype_str)
 
+    sched = _rs.matmul_reducescatter_schedule(p)
+
     def kernel(x_ref, w_ref, o_ref, acc, recv, send_sem, recv_sem,
                cbuf, csend, crecv):
-        me = lax.axis_index(axis)
-        left = _mod(me - 1, p)
-        right = _mod(me + 1, p)
-        credit = _Credit(cbuf, csend, crecv)
+        # the lax path: acc seeds with destination (me - 1), forwards to
+        # the RIGHT, and accumulates block (me - 1 - t) at step t; the
+        # in-flight-hop GEMM parks in ``tmp`` until the wait completes
+        tmp = {}
 
         def block(d):
             return jnp.dot(x_ref[pl.ds(d * m_loc, m_loc)], w_ref[...],
                            preferred_element_type=jnp.float32
                            ).astype(dtype)
 
-        # the lax path: acc seeds with destination (me - 1), forwards to
-        # the RIGHT, and accumulates block (me - 1 - t) at step t
-        acc[0] = block(_mod(me - 1, p))
-        a = 0
-        for t in range(1, p):
-            s = t % 2                        # revolving recv/send slots
-            d = pltpu.make_async_remote_copy(
-                src_ref=acc.at[a], dst_ref=recv.at[s],
-                send_sem=send_sem.at[a], recv_sem=recv_sem.at[s],
-                device_id=right,
-                device_id_type=pltpu.DeviceIdType.LOGICAL)
-            if t >= 3:
-                credit.take(right)           # right freed recv slot s
-            d.start()
-            # next destination block's GEMM runs while the partial rides
-            g = block(_mod(me - 1 - t, p))
-            d.wait()
-            acc[1 - a] = recv[s] + g
-            a = 1 - a
-            if 1 <= t <= p - 3:              # balance against the takes
-                credit.grant(left)
-        _copy_out = pltpu.make_async_copy(acc.at[a], o_ref, csend)
-        _copy_out.start()
-        _copy_out.wait()
+        def gemm(a):
+            if a["acc_slot"] is None:
+                tmp["g"] = block(a["d"])
+            else:
+                acc[a["acc_slot"]] = block(a["d"])
+
+        def accum(a):
+            acc[1 - a["a"]] = recv[a["s"]] + tmp["g"]
+
+        _emit(sched, lax.axis_index(axis), regions={
+            "acc": lambda k: acc.at[k[0]],
+            "recv": lambda k: recv.at[k[0]],
+            "o": lambda k: o_ref,
+            "cbuf": lambda k: cbuf,
+        }, sems={"send": send_sem, "recv": recv_sem, "csend": csend,
+                 "crecv": crecv},
+            computes={"gemm": gemm, "accum": accum})
 
     return pl.pallas_call(
         kernel,

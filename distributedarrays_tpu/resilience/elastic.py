@@ -174,7 +174,7 @@ class ElasticDeviceSet:
             _tm.count("elastic.marked_down")
             if _tm.enabled():
                 # cold path: a device transition is an exceptional event
-                _tm.event("elastic", "down", rank=int(rank),  # dalint: disable=DAL003
+                _tm.event("elastic", "down", rank=int(rank),
                           reason=reason)
         self._update_gauge()
 
@@ -191,7 +191,7 @@ class ElasticDeviceSet:
             was = self._manual_down.pop(int(rank), None)
         if was is not None and _tm.enabled():
             # cold path: a device transition is an exceptional event
-            _tm.event("elastic", "up", rank=int(rank))  # dalint: disable=DAL003
+            _tm.event("elastic", "up", rank=int(rank))
         self._update_gauge()
 
     def _hw_probe(self) -> set[int]:
@@ -257,7 +257,7 @@ class ElasticDeviceSet:
         _tm.count("elastic.probes")
         if changed and _tm.enabled():
             # cold path: only journaled on a health transition
-            _tm.event("elastic", "probe", live=len(live),  # dalint: disable=DAL003
+            _tm.event("elastic", "probe", live=len(live),
                       down=down, hw=sorted(hw), sim=sorted(sim))
         return {"live": live, "down": down, "changed": changed}
 
@@ -293,7 +293,7 @@ class ElasticDeviceSet:
         _tm.count("elastic.shrinks")
         if _tm.enabled():
             # cold path: one event per shrink epoch
-            _tm.event("elastic", "shrink", live=len(live),  # dalint: disable=DAL003
+            _tm.event("elastic", "shrink", live=len(live),
                       down=sorted(down), moved=moved, failed=len(failed))
             _tm.memory.sample("elastic.shrink")
         return {"live": live, "moved": moved, "failed": failed}
@@ -331,7 +331,7 @@ class ElasticDeviceSet:
         _tm.count("elastic.grows")
         if _tm.enabled():
             # cold path: one event per grow epoch
-            _tm.event("elastic", "grow", live=len(live),  # dalint: disable=DAL003
+            _tm.event("elastic", "grow", live=len(live),
                       moved=moved, failed=len(failed))
             _tm.memory.sample("elastic.grow")
         return {"live": live, "moved": moved, "failed": failed}

@@ -286,7 +286,7 @@ def decide(site: str, **labels) -> FaultSpec | None:
         _tm.count("faults.fired", site=site, action=spec.action)
         if _tm.enabled():
             # cold path: a firing fault is an exceptional event by design
-            _tm.event("faults", "fire", site=site, action=spec.action,  # dalint: disable=DAL003
+            _tm.event("faults", "fire", site=site, action=spec.action,
                       spec=spec.index, **{k: v for k, v in labels.items()
                                           if isinstance(v, (int, str))})
     return spec

@@ -206,7 +206,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                 _tm.count("recovery.interrupted", verdict=verdict)
             if _tm.enabled():
                 # cold path: one event per failed attempt
-                _tm.event("recovery", "failure", verdict=verdict,  # dalint: disable=DAL003
+                _tm.event("recovery", "failure", verdict=verdict,
                           attempt=attempt, retrying=retryable,
                           error=f"{type(e).__name__}: {str(e)[:300]}")
             if not retryable:
@@ -255,7 +255,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             _tm.count("recovery.recovered")
             if _tm.enabled():
                 # cold path: one event per recovered run
-                _tm.event("recovery", "recovered", attempts=attempt)  # dalint: disable=DAL003
+                _tm.event("recovery", "recovered", attempts=attempt)
         return out
 
 

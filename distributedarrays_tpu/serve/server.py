@@ -199,7 +199,7 @@ class Server:
                           deadline=now + budget, enqueued=now)
             self._ensure_started()
             try:
-                self._queue.put(req)
+                self._queue.put(req)  # dalint: disable=DAL008 — BatchQueue.put only appends + notifies under its own condition (never waits); depth is bounded at admission
             except RuntimeError:
                 # close() raced this submit: typed, never a bare error
                 _tm.count("serve.shed", reason="draining", tenant=tenant)
@@ -323,7 +323,7 @@ class Server:
             self._draining = True
         if _tm.enabled():
             # cold path: one event per drain
-            _tm.event("serve", "drain", depth=self._queue.depth())  # dalint: disable=DAL003
+            _tm.event("serve", "drain", depth=self._queue.depth())
         self._drain_wake.set()
         self._queue.wake()
         deadline = time.monotonic() + (self.config.drain_timeout_s
@@ -365,7 +365,7 @@ class Server:
             core.d_closeall()
         if _tm.enabled():
             # cold path: one event per close
-            _tm.event("serve", "close", drained=drained)  # dalint: disable=DAL003
+            _tm.event("serve", "close", drained=drained)
 
     def __enter__(self) -> "Server":
         return self

@@ -325,7 +325,7 @@ class CheckpointManager:
         if store not in ("npz", "orbax"):
             raise ValueError(f"unknown store {store!r} (use 'npz'/'orbax')")
         with self._lock:
-            self._reap(wait=False)
+            self._reap(wait=False)  # dalint: disable=DAL008 — wait=False reaps only done() futures; result() returns immediately
             # pending/reserved steps count as existing: a duplicate racing
             # an in-flight (or concurrently-encoding) save must get this
             # ValueError, not a later os.replace failure from the
@@ -426,7 +426,7 @@ class CheckpointManager:
                 _tm.count("checkpoint.restore_fallbacks")
                 if _tm.enabled():
                     # cold path: a partial/corrupt step is exceptional
-                    _tm.event("checkpoint", "restore_fallback",  # dalint: disable=DAL003
+                    _tm.event("checkpoint", "restore_fallback",
                               step=s, error=f"{type(e).__name__}: "
                                             f"{str(e)[:200]}")
         raise FileNotFoundError(
@@ -437,7 +437,7 @@ class CheckpointManager:
         """Block until every pending async save has been published (and
         re-raise the first background failure, if any)."""
         with self._lock:
-            self._reap(wait=True)
+            self._reap(wait=True)  # dalint: disable=DAL008 — wait() IS the quiesce API: holding the lock while IO drains is its contract (no save may interleave)
 
     def close(self) -> None:
         self.wait()

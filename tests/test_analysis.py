@@ -35,7 +35,7 @@ def codes(findings, *, suppressed=False):
 
 
 def test_rule_catalog_complete():
-    assert set(RULES) == {f"DAL00{i}" for i in range(1, 8)}
+    assert set(RULES) == {f"DAL00{i}" for i in range(1, 10)}
     for code, rule in RULES.items():
         assert rule.severity in ("error", "warning"), code
         assert rule.title, code
@@ -546,3 +546,448 @@ def test_finding_format_and_lint_paths(tmp_path):
     assert len(fs) == 1 and isinstance(fs[0], Finding)
     line = fs[0].format()
     assert "DAL005" in line and str(f) in line
+
+
+# ---------------------------------------------------------------------------
+# DAL008 — blocking call while holding a lock (analysis/locks.py)
+# ---------------------------------------------------------------------------
+
+_LOCKED_SLEEP = (
+    "import threading, time\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "    def f(self):\n"
+    "        with self._lock:\n"
+    "            time.sleep(1)\n")
+
+
+def test_dal008_fires_on_sleep_under_lock():
+    assert "DAL008" in codes(lint_source(_LOCKED_SLEEP))
+
+
+def test_dal008_silent_outside_lock():
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            x = 1\n"
+        "        time.sleep(1)\n")
+    assert "DAL008" not in codes(lint_source(src))
+
+
+def test_dal008_queue_put_under_lock():
+    src = (
+        "import threading, queue\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._queue = queue.Queue(4)\n"
+        "    def f(self, req):\n"
+        "        with self._lock:\n"
+        "            self._queue.put(req)\n")
+    assert "DAL008" in codes(lint_source(src))
+
+
+def test_dal008_dict_get_is_not_blocking():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._m = {}\n"
+        "    def f(self, k):\n"
+        "        with self._lock:\n"
+        "            return self._m.get(k)\n")
+    assert "DAL008" not in codes(lint_source(src))
+
+
+def test_dal008_condition_wait_releases_its_own_lock():
+    # cv.wait() under only its own condition: NOT blocking-under-lock
+    ok = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def f(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait(0.1)\n")
+    assert "DAL008" not in codes(lint_source(ok))
+    # ... but waiting while ANOTHER lock is also held IS a finding
+    bad = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cond = threading.Condition()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self._cond:\n"
+        "                self._cond.wait(0.1)\n")
+    assert "DAL008" in codes(lint_source(bad))
+
+
+def test_dal008_interprocedural_through_self_call():
+    # the blocker is two calls deep; the finding anchors at the locked
+    # call site and names the witness chain
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _backoff(self):\n"
+        "        time.sleep(0.5)\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            self._backoff()\n")
+    fs = [f for f in lint_source(src) if f.code == "DAL008"]
+    assert len(fs) == 1 and fs[0].line == 9
+    assert "_backoff" in fs[0].message
+
+
+def test_dal008_string_join_not_flagged():
+    src = (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f(parts):\n"
+        "    with _lock:\n"
+        "        return ' | '.join(parts)\n")
+    assert "DAL008" not in codes(lint_source(src))
+
+
+def test_dal008_thread_join_flagged():
+    src = (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f(worker):\n"
+        "    with _lock:\n"
+        "        worker.join(2.0)\n")
+    assert "DAL008" in codes(lint_source(src))
+
+
+def test_dal008_suppression():
+    src = _LOCKED_SLEEP.replace(
+        "time.sleep(1)",
+        "time.sleep(1)  # dalint: disable=DAL008 — demo justification")
+    fs = lint_source(src)
+    assert "DAL008" not in codes(fs)
+    assert "DAL008" in codes(fs, suppressed=True)
+
+
+# ---------------------------------------------------------------------------
+# DAL009 — lock-order cycles / non-reentrant re-acquisition
+# ---------------------------------------------------------------------------
+
+
+def test_dal009_abba_cycle():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n")
+    fs = [f for f in lint_source(src) if f.code == "DAL009"]
+    assert fs, "ABBA cycle must be reported"
+    assert any("cycle" in f.message and "C._a" in f.message
+               and "C._b" in f.message for f in fs)
+
+
+def test_dal009_consistent_order_is_clean():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n")
+    assert "DAL009" not in codes(lint_source(src))
+
+
+def test_dal009_nonreentrant_self_deadlock():
+    # the PR 7 SIGTERM-handler shape: close() re-enters submit()'s lock
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def close(self):\n"
+        "        with self._lock:\n"
+        "            self._drain()\n"
+        "    def _drain(self):\n"
+        "        with self._lock:\n"
+        "            pass\n")
+    # interprocedural: close holds _lock and calls _drain which
+    # re-acquires it -> cycle through the call edge is a self-edge;
+    # the direct shape is also caught
+    direct = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n")
+    assert "DAL009" in codes(lint_source(direct))
+    # an RLock makes the same shape legal (the PR 7 fix)
+    assert "DAL009" not in codes(lint_source(
+        direct.replace("threading.Lock()", "threading.RLock()")))
+    # the interprocedural variant (one call deep) must ALSO fire …
+    fs = [f for f in lint_source(src) if f.code == "DAL009"]
+    assert fs and "re-acquires" in fs[0].message, fs
+    # … and point at the call site inside close(), not at _drain()
+    assert fs[0].line == 7, fs[0]
+    # and the RLock variant of it is legal
+    assert "DAL009" not in codes(lint_source(
+        src.replace("threading.Lock()", "threading.RLock()")))
+
+
+def test_locks_cross_file_cycle():
+    # a cycle that only closes across modules: invisible to per-file
+    # lint, caught by the `locks` cross-file analysis
+    from distributedarrays_tpu.analysis import locks
+    a = (
+        "import threading\n"
+        "import b\n"
+        "LOCK_A = threading.Lock()\n"
+        "def fa():\n"
+        "    with LOCK_A:\n"
+        "        b.fb_inner()\n")
+    b = (
+        "import threading\n"
+        "import a\n"
+        "LOCK_B = threading.Lock()\n"
+        "def fb():\n"
+        "    with LOCK_B:\n"
+        "        a.fa_inner()\n"
+        "def fb_inner():\n"
+        "    with LOCK_B:\n"
+        "        pass\n")
+    a += "def fa_inner():\n    with LOCK_A:\n        pass\n"
+    rep = locks.analyze_sources([("pkg/a.py", a), ("pkg/b.py", b)])
+    dal9 = [f for f in rep.findings if f.code == "DAL009"]
+    assert dal9, "cross-file ABBA cycle must be reported"
+    # per-file lint of either file alone sees no cycle
+    assert "DAL009" not in codes(lint_source(a, "a.py"))
+    assert "DAL009" not in codes(lint_source(b, "b.py"))
+
+
+def test_locks_graph_format():
+    from distributedarrays_tpu.analysis import locks
+    src = (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n")
+    rep = locks.analyze_sources([("m.py", src)])
+    text = locks.format_graph(rep)
+    assert "m.A" in text and "m.B" in text and "→" in text
+
+
+# ---------------------------------------------------------------------------
+# engine edge cases (PR 9 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_file_level_and_per_line_suppressions_combine():
+    src = (
+        "# dalint: disable-file=DAL005\n"
+        "from os import *\n"
+        "from sys import *  # dalint: disable=DAL001 — wrong code\n")
+    fs = lint_source(src)
+    # the file-level DAL005 silences BOTH star imports (the per-line
+    # DAL001 comment is irrelevant to DAL005 findings)
+    assert codes(fs) == []
+    assert codes(fs, suppressed=True).count("DAL005") == 2
+
+
+def test_crlf_source_lints_and_suppresses():
+    src = ("from os import *\r\n"
+           "from sys import *  # dalint: disable=DAL005 — crlf demo\r\n")
+    fs = lint_source(src, "crlf.py")
+    assert codes(fs) == ["DAL005"]            # line 1 unsuppressed
+    assert codes(fs, suppressed=True) == ["DAL005"]   # line 2 silenced
+
+
+def test_syntax_error_file_is_a_finding_not_a_crash(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("from os import *\n")
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n")
+    fs = lint_paths([tmp_path])
+    by_code = {f.code for f in fs}
+    assert "DAL000" in by_code and "DAL005" in by_code
+    dal0 = [f for f in fs if f.code == "DAL000"]
+    assert dal0[0].severity == "error" and "syntax error" in dal0[0].message
+
+
+def test_unused_suppression_detection():
+    from distributedarrays_tpu.analysis import unused_suppressions
+    src = ("from os import *  # dalint: disable=DAL005 — used\n"
+           "x = 1  # dalint: disable=DAL006 — silences nothing\n"
+           "y = 2  # dalint: disable=DALNOPE — typo'd code\n")
+    fs = lint_source(src, "u.py")
+    extra = unused_suppressions(src, "u.py", fs)
+    msgs = [f.message for f in extra]
+    assert all(f.code == "DAL100" for f in extra)
+    assert len(extra) == 2
+    assert any("DAL006" in m for m in msgs)
+    assert any("DALNOPE" in m and "unknown rule code" in m for m in msgs)
+
+
+def test_unused_disable_file_keeper_and_anchor():
+    # the docs' keeper pattern: a deliberate unused disable-file kept
+    # with disable=DAL100 on the SAME line must come back suppressed
+    from distributedarrays_tpu.analysis import unused_suppressions
+    src = ("# dalint: disable-file=DAL003"
+           "  # dalint: disable=DAL100 — keeper\n"
+           "y = 1\n")
+    fs = unused_suppressions(src, "k.py", lint_source(src, "k.py"))
+    assert fs and fs[0].code == "DAL100" and fs[0].suppressed
+    # and without the keeper, the report anchors at the comment's own
+    # line (not line 1) so the keeper syntax has a line to land on
+    src2 = "x = 1\n# dalint: disable-file=DAL003\n"
+    fs2 = unused_suppressions(src2, "k.py", lint_source(src2, "k.py"))
+    assert fs2 and fs2[0].line == 2 and not fs2[0].suppressed
+
+
+def test_unused_suppression_respects_select_subset():
+    from distributedarrays_tpu.analysis import unused_suppressions
+    src = "x = 1  # dalint: disable=DAL006 — rule not run\n"
+    fs = lint_source(src, "u.py", select=["DAL005"])
+    # DAL006 never ran under --select DAL005: nothing can be concluded
+    assert unused_suppressions(src, "u.py", fs, ["DAL005"]) == []
+
+
+def test_docstring_suppression_examples_are_inert():
+    # a docstring QUOTING the syntax must neither suppress findings on
+    # its line nor count as an (unused) suppression
+    from distributedarrays_tpu.analysis import (parse_suppressions,
+                                                unused_suppressions)
+    src = ('"""Example:\n'
+           '    x = f()  # dalint: disable=DAL006 — demo\n'
+           '"""\n'
+           "y = 1\n")
+    per_line, whole = parse_suppressions(src.splitlines())
+    assert per_line == {} and whole == set()
+    assert unused_suppressions(src, "d.py", lint_source(src, "d.py")) == []
+
+
+@pytest.mark.slow
+def test_cli_formats_and_unused_warnings(tmp_path):
+    import json as _json
+    bad = tmp_path / "bad.py"
+    bad.write_text("from os import *\n"
+                   "x = 1  # dalint: disable=DAL006 — rotted\n")
+    base = [sys.executable, "-m", "distributedarrays_tpu.analysis",
+            "lint", str(bad)]
+    r = subprocess.run(base + ["--format", "json"], capture_output=True,
+                       text=True, cwd=str(REPO), timeout=180)
+    data = _json.loads(r.stdout)
+    assert r.returncode == 1
+    assert data[0]["code"] == "DAL005" and data[0]["line"] == 1
+    r = subprocess.run(base + ["--format", "github"],
+                       capture_output=True, text=True, cwd=str(REPO),
+                       timeout=180)
+    # DAL005 is severity "error" -> ::error workflow command
+    assert "::error " in r.stdout and "title=DAL005" in r.stdout
+    r = subprocess.run(base + ["--warn-unused-suppressions"],
+                       capture_output=True, text=True, cwd=str(REPO),
+                       timeout=180)
+    assert r.returncode == 1 and "DAL100" in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_changed_fast_mode(tmp_path):
+    import os
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=str(repo), check=True,
+                       capture_output=True, timeout=60)
+
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    clean = repo / "clean.py"
+    clean.write_text("from os import *\n")     # would fail a full lint
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    changed = repo / "changed.py"
+    changed.write_text("from sys import *\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis", "lint",
+         "--changed", str(repo)],
+        capture_output=True, text=True, cwd=str(repo), env=env,
+        timeout=180)
+    # only the new file is linted: one finding, the committed bad file
+    # never scanned
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "changed.py" in r.stdout and "clean.py" not in r.stdout
+    # a deleted tracked file appears in the diff but must be filtered
+    # out, not linted into a DAL000 'unreadable file' error
+    clean.unlink()
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis", "lint",
+         "--changed", str(repo)],
+        capture_output=True, text=True, cwd=str(repo), env=env,
+        timeout=180)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "DAL000" not in r.stdout and "clean.py" not in r.stdout
+    # an unresolvable merge base (typo'd --base, default branch outside
+    # the fallback chain) must exit 2 — NOT lint only the uncommitted
+    # files and report the committed bad ones as clean
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis", "lint",
+         "--changed", "--base", "no-such-ref", str(repo)],
+        capture_output=True, text=True, cwd=str(repo), env=env,
+        timeout=180)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "no merge base" in r.stderr
+
+
+@pytest.mark.slow
+def test_cli_locks_verb(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    with _lock:\n"
+        "        time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis",
+         "locks", str(bad)], capture_output=True, text=True,
+        cwd=str(REPO), timeout=180)
+    assert r.returncode == 1 and "DAL008" in r.stdout
+    bad.write_text(bad.read_text().replace(
+        "time.sleep(1)",
+        "time.sleep(1)  # dalint: disable=DAL008 — demo"))
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis",
+         "locks", str(bad)], capture_output=True, text=True,
+        cwd=str(REPO), timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr

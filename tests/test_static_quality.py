@@ -17,7 +17,7 @@ from pathlib import Path
 import pytest
 
 import distributedarrays_tpu as dat
-from distributedarrays_tpu.analysis import lint_paths
+from distributedarrays_tpu.analysis import RULES, lint_paths
 
 PKG_ROOT = Path(dat.__file__).resolve().parent
 REPO_ROOT = PKG_ROOT.parent
@@ -75,10 +75,31 @@ def test_no_star_imports():
 
 def test_dalint_self_clean():
     # the package gates itself: zero unsuppressed findings across the
-    # whole lint surface (suppressions carry their justification inline)
+    # whole lint surface (suppressions carry their justification inline).
+    # lint_paths runs EVERY registered rule, so this also arms the PR 9
+    # DAL008/DAL009 lock analyses — a new blocking-under-lock site or
+    # lock-order cycle fails here before CI
     targets = [PKG_ROOT, REPO_ROOT / "examples", REPO_ROOT / "bench.py"]
     active = [f for f in lint_paths(targets) if not f.suppressed]
     assert active == [], "\n".join(f.format() for f in active)
+    assert {"DAL008", "DAL009"} <= set(RULES), "lock rules must be armed"
+
+
+def test_dalint_no_rotted_suppressions():
+    # every `# dalint: disable=` comment must still silence something:
+    # the unused-suppression satellite (DAL100) as a standing gate, so
+    # justified suppressions cannot rot when the code around them heals
+    from distributedarrays_tpu.analysis.engine import (lint_file,
+                                                       unused_suppressions)
+    from distributedarrays_tpu.analysis.engine import iter_python_files
+    targets = [PKG_ROOT, REPO_ROOT / "examples", REPO_ROOT / "bench.py"]
+    stale = []
+    for f in iter_python_files(targets):
+        per_file = lint_file(f)
+        src = Path(f).read_text()
+        stale.extend(x for x in unused_suppressions(src, str(f), per_file)
+                     if not x.suppressed)
+    assert stale == [], "\n".join(f.format() for f in stale)
 
 
 def test_import_has_no_backend_side_effect():
