@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import layout as L
+from .. import telemetry as _tm
 from ..darray import DArray, _wrap_global
 from ..parallel.collectives import (axis_size as _axis_size,
                                     shard_map_compat)
@@ -305,24 +306,35 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
             "ring attention needs the sequence dim sharded evenly over a "
             f"1-D grid; got grid {q.pids.shape} for dims {q.dims}")
     from ..ops import pallas_collectives as _pc
+    from ..telemetry import perf as _perf
     rdma = _pc.rdma_mode()
-    out = None
-    if rdma:
-        fn, _ = _ring_jit_1d(tuple(pids), causal, rdma)
-        try:
-            out = fn(q.garray, k.garray, v.garray)
-        except Exception as e:
-            # the RDMA arm must never cost correctness: take the XLA
-            # ring, loudly once per failure signature
-            from ..utils.debug import warn_once
-            warn_once(f"ring_attention:rdma:{type(e).__name__}",
-                      f"ring_attention RDMA path failed "
-                      f"({type(e).__name__}: {e}); falling back to the "
-                      f"XLA ppermute ring")
-    if out is None:
-        out = _ring_jit(L.mesh_for(pids, (n, 1, 1)), causal)(
-            q.garray, k.garray, v.garray)
-    return _wrap_global(out, procs=pids, dist=[n, 1, 1])
+    s, h, dh = (int(d) for d in q.dims)
+    with _tm.span("ring_attention", ranks=n, causal=causal,
+                  dispatch="rdma" if rdma else "xla",
+                  # cost stamp: two s x s x dh GEMMs per head (halved
+                  # causal), q/k/v/o through HBM, k/v chunks rotating
+                  # p-1 ring steps over ICI — the doctor's overlap tier
+                  # reads comm-vs-compute per step from this
+                  **_perf.attention_cost(
+                      s, h, dh, np.dtype(q.dtype).itemsize, p=n,
+                      causal=causal)):
+        out = None
+        if rdma:
+            fn, _ = _ring_jit_1d(tuple(pids), causal, rdma)
+            try:
+                out = fn(q.garray, k.garray, v.garray)
+            except Exception as e:
+                # the RDMA arm must never cost correctness: take the XLA
+                # ring, loudly once per failure signature
+                from ..utils.debug import warn_once
+                warn_once(f"ring_attention:rdma:{type(e).__name__}",
+                          f"ring_attention RDMA path failed "
+                          f"({type(e).__name__}: {e}); falling back to "
+                          f"the XLA ppermute ring")
+        if out is None:
+            out = _ring_jit(L.mesh_for(pids, (n, 1, 1)), causal)(
+                q.garray, k.garray, v.garray)
+        return _wrap_global(out, procs=pids, dist=[n, 1, 1])
 
 
 def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,

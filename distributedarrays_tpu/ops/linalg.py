@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import layout as L
 from .. import telemetry as _tm
+from ..telemetry import perf as _perf
 from ..darray import DArray, SubDArray, _wrap_global, distribute
 from .broadcast import _unwrap, elementwise
 from ..parallel import reshard as _rs
@@ -293,8 +294,18 @@ def _ring_ag_gemm(A: DArray, B: DArray, out_dtype):
     procs = tuple(int(q) for q in A.pids.flat)
     from .pallas_collectives import rdma_mode
     rdma = rdma_mode()
+    m, k = (int(d) for d in A.dims)
+    n = int(B.dims[1])
+    isz = np.dtype(A.dtype).itemsize
+    osz = np.dtype(out_dtype).itemsize
     with _tm.span("matmul.ring_ag", ranks=p,
-                  dispatch="rdma" if rdma else "xla"):
+                  dispatch="rdma" if rdma else "xla",
+                  # cost stamp: the ring all-gathers B (each rank's
+                  # chunk forwarded p-1 hops) overlapped into the
+                  # per-chunk matmuls — the doctor's overlap tier reads
+                  # bytes_ici against flops per ring step
+                  **_perf.gemm_cost(m, n, k, isz, out_itemsize=osz,
+                                    bytes_ici=(p - 1) * k * n * isz)):
         mesh, ax, fn = _ring_ag_jit(procs, p, str(jnp.dtype(out_dtype)),
                                     rdma)
         with _tm.span("matmul.ring_ag.place", _journal=False):
@@ -432,7 +443,17 @@ def _summa_gemm(A: DArray, B: DArray, out_dtype):
     the (r,c)-block-sharded result array."""
     r, c = A.pids.shape
     procs = tuple(int(q) for q in A.pids.flat)
-    with _tm.span("matmul.summa", grid=f"{r}x{c}"):
+    m, k = (int(d) for d in A.dims)
+    n = int(B.dims[1])
+    isz = np.dtype(A.dtype).itemsize
+    with _tm.span("matmul.summa", grid=f"{r}x{c}", ranks=r * c,
+                  # cost stamp: panel broadcasts move each operand to
+                  # the rest of its grid row/column
+                  **_perf.gemm_cost(
+                      m, n, k, isz,
+                      out_itemsize=np.dtype(out_dtype).itemsize,
+                      bytes_ici=m * k * isz * (c - 1) // c
+                      + k * n * isz * (r - 1) // r)):
         mesh, (ax_r, ax_c), fn = _summa_jit(procs, r, c,
                                             str(jnp.dtype(out_dtype)))
         sh = NamedSharding(mesh, P(ax_r, ax_c))
@@ -736,8 +757,19 @@ def matmul(A, B, out: DArray | None = None, alpha=1.0, beta=0.0):
         a_bytes = int(np.prod(av_shape)) * np.dtype(A.dtype).itemsize
         b_bytes = _tm.nbytes_of(bv)
         _tm.count("op.matmul")
-        _tm.record_comm("collective",
-                        a_bytes * (c - 1) + b_bytes * (r - 1),
+        ici_est = a_bytes * (c - 1) + b_bytes * (r - 1)
+        # analytic cost stamp on the @traced matmul span (shapes were
+        # unknown when it opened): 2mnk flops, operands + result through
+        # HBM once, the SUMMA-volume ICI estimate — the doctor's
+        # roofline classification reads these.  Inline rather than
+        # perf.gemm_cost: A and B can carry different dtypes here, and
+        # a_bytes/b_bytes are the operands' actual byte counts
+        _tm.annotate(
+            flops=2 * m * n * k,
+            bytes_hbm=a_bytes + b_bytes
+            + m * n * np.dtype(out_dtype).itemsize,
+            bytes_ici=ici_est, grid=f"{r}x{c}")
+        _tm.record_comm("collective", ici_est,
                         op="matmul", grid=f"{r}x{c}",
                         shape=[m, k, n])
     # plain-mode dispatch to the hand-owned schedules (VERDICT round-3

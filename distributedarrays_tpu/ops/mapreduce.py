@@ -133,6 +133,17 @@ def dmapreduce(f: Callable, op_name_or_fn, d, dims=None):
     """
     _tm.count("op.mapreduce")
     with _tm.span("mapreduce"):
+        if _tm.enabled():
+            # cost stamp: ~1 flop and one HBM read per element (the map
+            # cost is unknown — this floor classifies the sweep
+            # HBM-bound, which is what a reduction is)
+            from ..telemetry import perf as _perf
+            try:
+                n_elems = int(np.prod(d.dims))
+                isz = np.dtype(d.dtype).itemsize
+            except (AttributeError, TypeError):
+                n_elems, isz = _tm.nbytes_of(d), 1
+            _tm.annotate(**_perf.reduce_cost(n_elems, isz))
         reducer = _REDUCERS.get(op_name_or_fn, op_name_or_fn) \
             if isinstance(op_name_or_fn, str) else op_name_or_fn
         if callable(reducer) and _is_binary_op(reducer):

@@ -175,15 +175,30 @@ def resolve_chunks(local_bytes: int, *key_parts) -> tuple[int, str]:
     return min(max(derived, 1), 64), "derived"
 
 
-def _record_dispatch(op: str, path: str, x, axis: str, **labels) -> None:
+def _record_dispatch(op: str, path: str, x, axis: str, p: int = 0,
+                     **labels) -> None:
     """Trace-time dispatch telemetry: a labeled counter plus, on the
     RDMA path, a comm-byte record mirroring
     ``parallel.collectives._rec`` (these helpers run inside shard_map
     tracing — once per compilation, flagged traced).  The ``xla`` path
     only counts the dispatch: its ``lax`` lowering records its own
-    bytes, and two records for one transfer would double-count."""
+    bytes, and two records for one transfer would double-count.
+
+    ``p`` (the ring size) adds a ``bytes_ici`` provenance stamp to the
+    comm record — PER-DEVICE ring volume, matching this record's
+    per-rank-block byte convention (``collectives._rec``).  The
+    execution-tier roofline stamp the doctor reads lives on the calling
+    op's span (``reshard``, ``matmul.ring_ag``, ``ring_attention``) in
+    aggregate-volume convention; a direct ring-kernel call inside a
+    user's own shard_map has no execution span and should be wrapped in
+    one (see docs/telemetry.md, *Performance observatory*)."""
     _tm.count("pallas_collectives.dispatch", op=op, path=path)
     if path == "rdma" and _tm.enabled():
+        if p and p > 1:
+            # every ring kernel forwards each resident/received piece
+            # p-1 hops: per-device ICI volume = (p-1) x the local payload
+            labels = {**labels,
+                      "bytes_ici": (p - 1) * _tm.nbytes_of(x)}
         _tm.record_comm(op, _tm.nbytes_of(x), axis=axis, traced=True,
                         dispatch=path,
                         once_key=f"pallas_collectives:{op}:{path}:{axis}:"
@@ -333,7 +348,7 @@ def ring_all_gather(x, axis: str, *, dim: int = 0,
     if mode is None:
         _record_dispatch("ring_all_gather", "xla", x, axis)
         return pgather(x, axis, tiled=True, dim=dim)
-    _record_dispatch("ring_all_gather", "rdma", x, axis, mode=mode)
+    _record_dispatch("ring_all_gather", "rdma", x, axis, p=p, mode=mode)
     shape = tuple(int(s) for s in x.shape)
     return _ag_call(axis, p, shape, str(x.dtype), dim,
                     mode == "interpret")(x)
@@ -437,7 +452,7 @@ def ring_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
                            concat_dim=concat_dim)
     nc, src = (chunks, "arg") if chunks else a2a_chunks_for(
         shape, str(x.dtype), p, concat_dim)
-    _record_dispatch("ring_all_to_all", "rdma", x, axis, mode=mode,
+    _record_dispatch("ring_all_to_all", "rdma", x, axis, p=p, mode=mode,
                      chunks=nc, chunks_source=src)
     return _a2a_call(axis, p, shape, str(x.dtype), split_dim, concat_dim,
                      nc, mode == "interpret")(x)
@@ -549,7 +564,7 @@ def ring_reduce_scatter(x, axis: str, *, dim: int = 0,
     if mode is None:
         _record_dispatch("ring_reduce_scatter", "xla", x, axis)
         return lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)
-    _record_dispatch("ring_reduce_scatter", "rdma", x, axis, mode=mode,
+    _record_dispatch("ring_reduce_scatter", "rdma", x, axis, p=p, mode=mode,
                      chunks=nc, chunks_source=src)
     return _rs_call(axis, p, shape, str(x.dtype), dim, nc,
                     mode == "interpret")(x)
@@ -639,7 +654,7 @@ def ring_allgather_matmul(x, w, axis: str, *,
         mode = None
     if p == 1 or mode is None or x.dtype != w.dtype:
         return None                          # caller takes the lax path
-    _record_dispatch("ring_allgather_matmul", "rdma", x, axis, mode=mode)
+    _record_dispatch("ring_allgather_matmul", "rdma", x, axis, p=p, mode=mode)
     return _ag_mm_call(axis, p, tuple(map(int, x.shape)),
                        tuple(map(int, w.shape)), str(x.dtype),
                        str(out_dtype), mode == "interpret")(x, w)
@@ -705,7 +720,7 @@ def ring_allgather_matmul_rhs(a, b, axis: str, *,
         mode = None
     if p == 1 or mode is None or a.dtype != b.dtype:
         return None
-    _record_dispatch("ring_allgather_matmul_rhs", "rdma", b, axis,
+    _record_dispatch("ring_allgather_matmul_rhs", "rdma", b, axis, p=p,
                      mode=mode)
     return _ag_mm_rhs_call(axis, p, tuple(map(int, a.shape)),
                            tuple(map(int, b.shape)), str(a.dtype),
@@ -778,7 +793,7 @@ def ring_matmul_reducescatter(x, w, axis: str, *,
         mode = None
     if p == 1 or mode is None or x.dtype != w.dtype or x.shape[0] % p:
         return None
-    _record_dispatch("ring_matmul_reducescatter", "rdma", x, axis,
+    _record_dispatch("ring_matmul_reducescatter", "rdma", x, axis, p=p,
                      mode=mode)
     out_dtype = jnp.result_type(x.dtype, w.dtype)
     return _mm_rs_call(axis, p, tuple(map(int, x.shape)),

@@ -294,6 +294,13 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     out_dtype = jnp.result_type(a.dtype, b.dtype)
     ab, bb = jnp.dtype(a.dtype).itemsize, jnp.dtype(b.dtype).itemsize
     ob = jnp.dtype(out_dtype).itemsize
+    if _tm.enabled():
+        # cost stamp on the @traced dispatch span (shapes were unknown
+        # when it opened): single-device GEMM, no ICI.  Inline rather
+        # than perf.gemm_cost: a and b can carry different dtypes
+        _tm.annotate(flops=2 * m * n * ka,
+                     bytes_hbm=m * ka * ab + ka * n * bb + m * n * ob,
+                     bytes_ici=0, shape=[m, ka, n])
 
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul",
@@ -388,6 +395,12 @@ def pallas_matmul_int8(qa, qb, a_scale, b_scale,
         raise ValueError(f"matmul dim mismatch {qa.shape} @ {qb.shape}")
     if interpret is None:
         interpret = not _on_tpu()
+    if _tm.enabled():
+        # cost stamp: int8 operands, dequantized output through HBM
+        from ..telemetry import perf as _perf
+        _tm.annotate(shape=[m, ka, n], **_perf.gemm_cost(
+            m, n, ka, 1,
+            out_itemsize=jnp.dtype(out_dtype).itemsize))
     safe_k = (2**31 - 1) // (127 * 127)
     if ka > safe_k:
         # worst-case saturated operands overflow the int32 accumulator

@@ -60,6 +60,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import layout as L
 from .. import telemetry as _tm
+from ..telemetry import perf as _perf
 from ..resilience import faults as _fl
 from .collectives import pall_to_all, pgather, shard_map_compat
 
@@ -504,7 +505,15 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
                 plan.src_dim)
     with _tm.span("reshard", op=op, strategy=plan.strategy,
                   dispatch="rdma" if rdma else "xla",
-                  rdma_chunks=rdma_chunks, rdma_chunks_source=chunks_src):
+                  rdma_chunks=rdma_chunks, rdma_chunks_source=chunks_src,
+                  nparts=plan.nparts,
+                  # analytic cost stamp (telemetry.perf): every byte
+                  # read + rewritten through HBM, the plan's MOVED bytes
+                  # crossing a device boundary over ICI, zero flops —
+                  # the doctor classifies each occurrence against the
+                  # platform roofline from these
+                  **_perf.reshard_cost(plan.total_bytes,
+                                       plan.moved_bytes)):
         if plan.collective:
             # chaos site: an armed fault plan can abort the planned
             # collective here — mid-reshard, before any chunk moves, so

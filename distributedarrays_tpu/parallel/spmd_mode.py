@@ -458,6 +458,9 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
         raise ValueError("pids disagree with explicit context's pids")
     _tm.count("spmd.runs", backend=backend)
     if _tm.enabled():
+        # the @traced spmd.run span opened without knowing the backend
+        # or rank count — stamp them now (per-call labels on the span)
+        _tm.annotate(backend=backend, ranks=len(ctx.pids))
         _tm.event("spmd", "run", backend=backend, ranks=len(ctx.pids),
                   once_key=f"spmd:run:{backend}:{len(ctx.pids)}")
     checker = None
@@ -514,10 +517,18 @@ def _fanout_thread_ranks(ctx: SPMDContext, f: Callable, args: tuple,
     result}``; raises (after recording a flight bundle) on any failure."""
     results: dict[int, Any] = {}
     errors: dict[int, BaseException] = {}
+    # request-trace propagation: contextvars do not cross thread starts,
+    # so capture the caller's trace ids here and rebind inside each rank
+    # task — a serve request's id reaches its rank steps (and the spans/
+    # events they record) without touching the span parent isolation
+    # (fresh threads still root their own span timelines)
+    trace_ids = _tm.current_trace_ids()
 
     def run(rank: int):
         core._rank_tls.rank = rank
         _tls.ctxt = ctx
+        if trace_ids:
+            _tm.tracing.bind_trace_ids(trace_ids)
         try:
             # deterministic chaos: an armed fault plan can kill/hang this
             # rank at task start — the thread-backend "host death" site

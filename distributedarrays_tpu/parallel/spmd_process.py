@@ -172,12 +172,24 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
         except (ValueError, OSError):  # pragma: no cover — exotic platform
             pass
         try:
+            # the rank's step interval, measured in-child against the
+            # fork-inherited telemetry origin (_tc._T0) and shipped home
+            # with the result: counters bumped in a forked child die with
+            # it, so the parent records the span — both backends produce
+            # rank-labeled spmd.step spans this way
+            from ..telemetry import core as _tc
+            step_t0 = time.monotonic()
+            span_rec = {"start": step_t0 - _tc._T0, "dur": 0.0,
+                        "ok": True}
             try:
                 _fl.act(dooms.get(rank),
                         {"rank": rank, "backend": "process"})
                 r = f(*args)
+                span_rec["dur"] = time.monotonic() - step_t0
                 status = (rank, "ok", r, rctx.store.get(rank, {}))
             except BaseException as e:  # noqa: BLE001 — shipped to parent
+                span_rec["dur"] = time.monotonic() - step_t0
+                span_rec["ok"] = False
                 failed.set()
                 # mark peer-abort secondaries structurally so the parent
                 # needn't string-match user tracebacks
@@ -197,7 +209,7 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
                     rctx._stash.append(queues[rank].get_nowait())
             except queue_mod.Empty:
                 pass
-            result_q.put(status + (rctx._stash,))
+            result_q.put(status + (rctx._stash, span_rec))
         finally:
             # mp.Queue.put hands off to a feeder thread; flush every queue
             # this child wrote (messages AND result) before the hard exit,
@@ -289,8 +301,8 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
                     f"spmd process run did not finish in {timeout}s "
                     f"(completed ranks: {sorted(results)})")
             try:
-                rank, status, payload, store, stash = result_q.get(
-                    timeout=min(remaining, 0.2))
+                (rank, status, payload, store, stash,
+                 span_rec) = result_q.get(timeout=min(remaining, 0.2))
             except queue_mod.Empty:
                 drain(set(results) | set(errors))
                 dead = [p for p, pr in zip(ctx.pids, procs)
@@ -304,6 +316,17 @@ def run_spmd_process(f: Callable, args: tuple, ctx, timeout: float):
                         "child crashed)")
                 continue
             leftover[rank] = list(stash)
+            if _tm.enabled() and isinstance(span_rec, dict):
+                # the child-measured rank step, recorded parent-side:
+                # rank-labeled like the thread backend's spmd.step, so
+                # per-rank timelines separate into their own Perfetto
+                # tracks on this backend too
+                _tm.record_external_span(
+                    "spmd.step", span_rec.get("start", 0.0),
+                    span_rec.get("dur", 0.0),
+                    labels={"rank": rank, "backend": "process"},
+                    tname=f"spmd-{rank}",
+                    error=not span_rec.get("ok", True))
             if status == "ok":
                 results[rank] = payload
                 stores[rank] = store

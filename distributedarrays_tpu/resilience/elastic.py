@@ -263,8 +263,12 @@ class ElasticDeviceSet:
 
     def _update_gauge(self) -> None:
         if _tm.enabled():
-            _tm.set_gauge("elastic.live_devices", len(self.live_ranks()))
-            _tm.set_gauge("elastic.down_devices", len(self.down_ranks()))
+            # journaled: device-count history reconstructs as a Perfetto
+            # counter track next to the HBM/serve counters
+            _tm.set_gauge("elastic.live_devices", len(self.live_ranks()),
+                          journal=True)
+            _tm.set_gauge("elastic.down_devices", len(self.down_ranks()),
+                          journal=True)
 
     # -- re-layout ---------------------------------------------------------
 

@@ -60,6 +60,12 @@ class TokenBucket:
                 return 0.0
             return (n - self._tokens) / self.rate
 
+    def level(self) -> float:
+        """Tokens remaining as of the last refill (no refill applied —
+        an observability read, not an admission decision)."""
+        with self._lock:
+            return self._tokens
+
 
 class LatencyWindow:
     """Rolling window of recent latencies with percentile queries — the
@@ -143,6 +149,12 @@ class AdmissionController:
                     tenant, (self.tenant_rate, self.tenant_burst))
                 b = self._buckets[tenant] = TokenBucket(rate, burst)
             return b
+
+    def token_level(self, tenant: str) -> float:
+        """Remaining quota tokens for ``tenant`` — an observability
+        read (no refill applied), for the server's per-tenant token
+        gauge."""
+        return self._bucket(tenant).level()
 
     # -- retry_after estimation --------------------------------------------
 

@@ -58,7 +58,9 @@ def payload_key(payload: Any) -> Any:
 class Request:
     """One admitted request: payload plus routing/budget metadata and the
     future the caller holds.  ``deadline``/``enqueued`` are monotonic
-    seconds (``time.monotonic``)."""
+    seconds (``time.monotonic``).  ``trace_id`` is the request-scoped
+    trace id minted at submit — every span from submit through batch
+    dispatch, recovery retries, and rank steps carries it."""
 
     endpoint: str
     payload: Any
@@ -66,6 +68,7 @@ class Request:
     key: Any
     deadline: float
     enqueued: float
+    trace_id: str = ""
     future: Future = dataclasses.field(default_factory=Future)
 
     def remaining(self, now: float | None = None) -> float:
@@ -109,6 +112,9 @@ class BatchQueue:
         # check can never observe "queue empty" while a claimed batch
         # has not yet reached its dispatcher (the drain TOCTOU)
         self._claimed = 0
+        # set under the condition when the queue shrinks; the journaled
+        # depth gauge (file I/O) is emitted only after the lock drops
+        self._depth_dirty = False
 
     def depth(self) -> int:
         with self._cond:
@@ -131,8 +137,12 @@ class BatchQueue:
             if self._closed:
                 raise RuntimeError("queue closed")   # server gates earlier
             self._q.append(req)
-            _tm.set_gauge("serve.queue_depth", len(self._q))
+            depth = len(self._q)
             self._cond.notify_all()
+        # journaled gauge OUTSIDE the condition (the queue-depth history
+        # reconstructs as a Perfetto counter track): the journal write is
+        # file I/O and must never serialize producers on the queue lock
+        _tm.set_gauge("serve.queue_depth", depth, journal=True)
 
     def close(self) -> None:
         """Stop waits: next_batch drains what is queued, then returns
@@ -150,7 +160,7 @@ class BatchQueue:
         expired = [r for r in self._q if r.deadline <= now]
         if expired:
             self._q = [r for r in self._q if r.deadline > now]
-            _tm.set_gauge("serve.queue_depth", len(self._q))
+            self._depth_dirty = True     # gauge emitted after unlock
             dead.extend(expired)
 
     def next_batch(self, limits, wait_s: float = 0.2) -> \
@@ -171,7 +181,14 @@ class BatchQueue:
             return self._form_batch(limits, wait_s, dead)
         finally:
             # futures resolve OUTSIDE the queue lock: Future callbacks
-            # are user code and must not run with internal locks held
+            # are user code and must not run with internal locks held —
+            # and the journaled depth gauge (file I/O) flushes here for
+            # the same reason
+            if self._depth_dirty:
+                with self._cond:
+                    self._depth_dirty = False
+                    depth = len(self._q)
+                _tm.set_gauge("serve.queue_depth", depth, journal=True)
             for r in dead:
                 r.expire("batch")
 
@@ -207,6 +224,6 @@ class BatchQueue:
                     taken = set(map(id, batch))
                     self._q = [r for r in self._q if id(r) not in taken]
                     self._claimed += 1     # atomic with the removal
-                    _tm.set_gauge("serve.queue_depth", len(self._q))
+                    self._depth_dirty = True
                     return batch
                 self._cond.wait(min(flush_at - now, 0.05))

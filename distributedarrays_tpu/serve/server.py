@@ -33,6 +33,7 @@ the dispatch timeline per worker thread).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import signal
 import threading
@@ -48,6 +49,16 @@ from .batching import BatchQueue, Request, payload_key
 from .errors import DeadlineExceeded, Draining, RequestFailed, ServeError
 
 __all__ = ["ServeConfig", "Endpoint", "Server", "install_sigterm"]
+
+# request trace ids: pid-scoped monotonic counter — unique within the
+# process, readable in a journal ("req-<pid>-<n>"), deterministic in
+# tests.  Minted at submit(), carried on every span to resolve.
+_REQ_IDS = itertools.count(1)
+
+# SLO histogram bucket bounds (seconds) for the per-endpoint
+# ``serve.slo.request_s`` family (da_tpu_serve_slo_* in Prometheus)
+_SLO_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 
 @dataclasses.dataclass
@@ -163,48 +174,74 @@ class Server:
     # -- submission --------------------------------------------------------
 
     def submit(self, endpoint: str, payload: Any, *, tenant: str = "default",
-               deadline_s: float | None = None, key: Any = None) -> Future:
+               deadline_s: float | None = None, key: Any = None,
+               trace_id: str | None = None) -> Future:
         """Admit one request; returns its future, or raises a typed
         rejection (:class:`Draining`, :class:`DeadlineExceeded`,
         :class:`QuotaExceeded`, :class:`Overloaded`) without enqueueing.
         The future resolves to the endpoint's result for this payload, or
-        raises the typed error the request ended with."""
+        raises the typed error the request ended with.
+
+        Every request gets a trace id (``trace_id`` to propagate a
+        caller-supplied one, else minted here): the ``serve.submit``
+        span, the batch's ``serve.dispatch``/``serve.resolve`` spans,
+        recovery retries, and SPMD rank steps under the dispatch all
+        carry it — the submit-to-resolve journey reconstructs from the
+        journal and exports as a Perfetto flow."""
+        tid = trace_id or f"req-{os.getpid()}-{next(_REQ_IDS)}"
         _tm.count("serve.submitted", tenant=tenant)
-        # ONE locked section from the draining check through the enqueue:
-        # a request is admitted iff it is enqueued before drain() flips
-        # _draining (so the flush is guaranteed to cover it), and the
-        # queue-depth bound is checked atomically with the put (so
-        # concurrent submitters cannot overshoot max_queue)
-        with self._lock:
-            if self._draining or self._closed:
-                _tm.count("serve.shed", reason="draining", tenant=tenant)
-                raise Draining(tenant=tenant)
-            ep = self._endpoints.get(endpoint)
-            if ep is None:
-                raise ServeError(f"unknown endpoint {endpoint!r} "
-                                 f"(registered: {sorted(self._endpoints)})")
-            budget = (self.config.default_deadline_s
-                      if deadline_s is None else float(deadline_s))
-            now = time.monotonic()
-            if budget <= 0:
-                _tm.count("serve.expired", stage="enqueue")
-                raise DeadlineExceeded(
-                    f"request arrived with no budget "
-                    f"(deadline_s={budget:g})", stage="enqueue")
-            # the admission gate: queue bound -> HBM -> p99 -> quota
-            # (the consuming token bucket last; see admission.admit)
-            self._admission.admit(tenant, self._queue.depth())
-            req = Request(endpoint=endpoint, payload=payload,
-                          tenant=tenant, key=ep.key_fn(payload),
-                          deadline=now + budget, enqueued=now)
-            self._ensure_started()
-            try:
-                self._queue.put(req)  # dalint: disable=DAL008 — BatchQueue.put only appends + notifies under its own condition (never waits); depth is bounded at admission
-            except RuntimeError:
-                # close() raced this submit: typed, never a bare error
-                _tm.count("serve.shed", reason="draining", tenant=tenant)
-                raise Draining(tenant=tenant) from None
+        with _tm.trace_ctx(tid), \
+                _tm.span("serve.submit", endpoint=endpoint, tenant=tenant,
+                         bytes_hbm=_tm.nbytes_of(payload)):
+            # ONE locked section from the draining check through the
+            # enqueue: a request is admitted iff it is enqueued before
+            # drain() flips _draining (so the flush is guaranteed to
+            # cover it), and the queue-depth bound is checked atomically
+            # with the put (so concurrent submitters cannot overshoot
+            # max_queue)
+            with self._lock:
+                if self._draining or self._closed:
+                    _tm.count("serve.shed", reason="draining",
+                              tenant=tenant)
+                    raise Draining(tenant=tenant)
+                ep = self._endpoints.get(endpoint)
+                if ep is None:
+                    raise ServeError(
+                        f"unknown endpoint {endpoint!r} "
+                        f"(registered: {sorted(self._endpoints)})")
+                budget = (self.config.default_deadline_s
+                          if deadline_s is None else float(deadline_s))
+                now = time.monotonic()
+                if budget <= 0:
+                    _tm.count("serve.expired", stage="enqueue")
+                    raise DeadlineExceeded(
+                        f"request arrived with no budget "
+                        f"(deadline_s={budget:g})", stage="enqueue")
+                # the admission gate: queue bound -> HBM -> p99 -> quota
+                # (the consuming token bucket last; see admission.admit)
+                self._admission.admit(tenant, self._queue.depth())
+                req = Request(endpoint=endpoint, payload=payload,
+                              tenant=tenant, key=ep.key_fn(payload),
+                              deadline=now + budget, enqueued=now,
+                              trace_id=tid)
+                self._ensure_started()
+                try:
+                    self._queue.put(req)  # dalint: disable=DAL008 — BatchQueue.put only appends + notifies under its own condition (never waits); depth is bounded at admission
+                except RuntimeError:
+                    # close() raced this submit: typed, never bare
+                    _tm.count("serve.shed", reason="draining",
+                              tenant=tenant)
+                    raise Draining(tenant=tenant) from None
         _tm.count("serve.admitted", tenant=tenant)
+        if _tm.enabled():
+            # journaled AFTER self._lock drops (the write is file I/O;
+            # doing it under the lock would serialize all submitters on
+            # the journal disk): per-tenant token-level history
+            # reconstructs as a Perfetto counter track next to queue
+            # depth
+            _tm.set_gauge("serve.tokens",
+                          self._admission.token_level(tenant),
+                          tenant=tenant, journal=True)
         return req.future
 
     # -- dispatch loop -----------------------------------------------------
@@ -258,12 +295,21 @@ class Server:
                 r.expire("dispatch")
         if not live:
             return
+        # the batch's trace context: every member request's id, so the
+        # dispatch span, recovery retries, and any SPMD rank spans under
+        # the endpoint body carry the submit-minted ids end to end
+        with _tm.trace_ctx(*(r.trace_id for r in live)):
+            self._dispatch_traced(ep, live)
+
+    def _dispatch_traced(self, ep: Endpoint, live: list[Request]) -> None:
         payloads = [r.payload for r in live]
         t0 = time.monotonic()
         _tm.count("serve.batches", endpoint=ep.name)
         try:
             with _tm.span("serve.dispatch", endpoint=ep.name,
-                          size=len(live)):
+                          size=len(live),
+                          bytes_hbm=sum(_tm.nbytes_of(p)
+                                        for p in payloads)):
                 def _run():
                     # chaos site: a fault plan can kill a device mid-batch
                     # here; recovery re-invokes this closure on retry
@@ -303,12 +349,17 @@ class Server:
             for r in live:
                 r.fail(err)
             return
-        done = time.monotonic()
-        for r, v in zip(live, results):
-            r.resolve(v)
-            _tm.observe("serve.request_latency_s", done - r.enqueued,
-                        endpoint=ep.name)
-        _tm.count("serve.completed", n=len(live), endpoint=ep.name)
+        with _tm.span("serve.resolve", endpoint=ep.name, size=len(live)):
+            done = time.monotonic()
+            for r, v in zip(live, results):
+                r.resolve(v)
+                _tm.observe("serve.request_latency_s", done - r.enqueued,
+                            endpoint=ep.name)
+                # per-endpoint SLO histogram: submit-to-resolve latency
+                # into fixed buckets -> da_tpu_serve_slo_request_s_bucket
+                _tm.observe("serve.slo.request_s", done - r.enqueued,
+                            buckets=_SLO_BUCKETS, endpoint=ep.name)
+            _tm.count("serve.completed", n=len(live), endpoint=ep.name)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -41,10 +41,15 @@ from .core import (enabled, enable, disable, configure, reset, count,
                    report, dump)
 from .summarize import read_journal, summarize, format_summary
 from .tracing import (Span, span, traced, current_span, current_span_id,
-                      spans, span_stats, open_spans)
+                      spans, span_stats, open_spans, annotate, trace_ctx,
+                      current_trace_ids, bind_trace_ids,
+                      record_external_span)
 from .export import to_perfetto, to_prometheus
 from . import memory
 from . import flight
+from . import perf
+from . import regress
+from . import tracing
 from .memory import leak_census
 from .flight import postmortem, record_crash
 
@@ -55,6 +60,9 @@ __all__ = [
     "journal_path", "nbytes_of", "report", "dump",
     "read_journal", "summarize", "format_summary",
     "Span", "span", "traced", "current_span", "current_span_id",
-    "spans", "span_stats", "open_spans", "to_perfetto", "to_prometheus",
-    "memory", "flight", "leak_census", "postmortem", "record_crash",
+    "spans", "span_stats", "open_spans", "annotate", "trace_ctx",
+    "current_trace_ids", "bind_trace_ids", "record_external_span",
+    "to_perfetto", "to_prometheus",
+    "memory", "flight", "perf", "regress", "tracing",
+    "leak_census", "postmortem", "record_crash",
 ]

@@ -94,6 +94,14 @@ except Exception:  # pragma: no cover
 _CURRENT_SPAN: contextvars.ContextVar = contextvars.ContextVar(
     "da_tpu_current_span", default=None)
 
+# the request-scoped trace ids bound to this context (a tuple of strings,
+# or None) — written by telemetry/tracing.trace_ctx, read here so journal
+# events (and Spans) are stamped with the requests they belong to.  Lives
+# in core for the same reason _CURRENT_SPAN does: event() needs it and
+# core cannot import tracing.
+_TRACE_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "da_tpu_trace_ctx", default=None)
+
 # extension points so sibling modules (tracing) can plug into report() /
 # reset() without core importing them (core stays stdlib-only, cycle-free)
 _report_sections: dict = {}
@@ -217,26 +225,40 @@ def count(name: str, n: float = 1, **labels) -> None:
         _counters[k] = _counters.get(k, 0) + n
 
 
-def set_gauge(name: str, value: float, **labels) -> None:
-    """Set gauge ``name`` to ``value``."""
+def set_gauge(name: str, value: float, *, journal: bool = False,
+              **labels) -> None:
+    """Set gauge ``name`` to ``value``.  ``journal=True`` additionally
+    records a ``gauge`` journal event — opt in at sites whose *history*
+    matters (serve queue depth, admission token levels, elastic live
+    devices): the Perfetto export reconstructs counter tracks from these
+    events, where the registry alone only keeps the last value."""
     if not _ENABLED:
         return
     k = _key(name, labels)
     with _LOCK:
         _gauges[k] = value
+    if journal:
+        event("gauge", name, value=value, **labels)
 
 
-def observe(name: str, value: float, **labels) -> None:
+def observe(name: str, value: float, *, buckets=None, **labels) -> None:
     """Record ``value`` into summary histogram ``name`` (count / total /
-    min / max; mean derived at report time)."""
+    min / max; mean derived at report time).
+
+    ``buckets`` (a sorted sequence of upper bounds) upgrades the entry to
+    a bucketed histogram: the value lands in the smallest bucket whose
+    bound covers it (``+Inf`` above the last).  Bucket counts are stored
+    non-cumulative; the Prometheus exporter renders the cumulative
+    ``_bucket{le=...}`` series — this is what the per-endpoint serving
+    SLO histograms (``da_tpu_serve_slo_*``) ride on."""
     if not _ENABLED:
         return
     k = _key(name, labels)
     with _LOCK:
         h = _hists.get(k)
         if h is None:
-            _hists[k] = {"count": 1, "total": value,
-                         "min": value, "max": value}
+            h = _hists[k] = {"count": 1, "total": value,
+                             "min": value, "max": value}
         else:
             h["count"] += 1
             h["total"] += value
@@ -244,6 +266,17 @@ def observe(name: str, value: float, **labels) -> None:
                 h["min"] = value
             if value > h["max"]:
                 h["max"] = value
+        if buckets is not None:
+            bk = h.setdefault("buckets", {})
+            for b in buckets:
+                if value <= b:
+                    key = str(float(b))
+                    break
+            else:
+                key = "+Inf"
+            bk[key] = bk.get(key, 0) + 1
+            if "bounds" not in h:
+                h["bounds"] = [float(b) for b in buckets]
 
 
 def counter_value(name: str, **labels) -> float:
@@ -298,6 +331,9 @@ def event(category: str, name: str | None = None, *,
             rec["name"] = name
         if sp is not None and "span_id" not in fields:
             rec["span_id"] = sp.span_id
+        tr = _TRACE_CTX.get()
+        if tr and "trace_id" not in fields:
+            rec["trace_id"] = list(tr)
         for k, v in fields.items():
             rec[k] = _jsonable(v)
         _events_total += 1
@@ -453,7 +489,9 @@ def report() -> dict:
             "counters": dict(_counters),
             "gauges": dict(_gauges),
             "histograms": {
-                k: {**h, "mean": h["total"] / h["count"]}
+                k: {**h, "mean": h["total"] / h["count"],
+                    **({"buckets": dict(h["buckets"])}
+                       if "buckets" in h else {})}
                 for k, h in _hists.items()
             },
             "comm": {

@@ -22,6 +22,10 @@ __all__ = ["to_perfetto", "to_prometheus"]
 # journal bookkeeping keys that are not user "args" of an event
 _EVENT_META = ("seq", "t", "wall", "cat", "name", "tid", "host", "pid")
 
+# spans labeled rank=N render on synthetic per-rank tracks (tid = base +
+# rank) instead of whatever thread happened to run them
+_RANK_TRACK_BASE = 1 << 20
+
 
 def _us(seconds) -> float:
     return round(float(seconds) * 1e6, 3)
@@ -44,20 +48,39 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
     rest = [e for e in events if e.get("cat") != "span"]
     trace = []
     threads: dict[int, str] = {}
+    # request flows: spans carrying the same trace id chain together with
+    # Chrome flow events (s/t/f), so a serve request's journey — submit,
+    # batch dispatch, retries, rank steps — draws as one arrowed path
+    flows: dict[str, list] = {}
     for s in spans:
         if s.get("dur") is None:
             continue                       # still-open span snapshot
         tid = int(s.get("tid") or 0)
-        if s.get("tname"):
+        labels = s.get("labels") or {}
+        rank = labels.get("rank")
+        if rank is not None:
+            # per-rank timelines get their own tracks: SPMD rank spans
+            # would otherwise interleave on whatever thread/process tid
+            # happened to run them (thread tids are reused across runs;
+            # process-backend spans are recorded parent-side)
+            try:
+                tid = _RANK_TRACK_BASE + int(rank)
+                threads.setdefault(tid, f"rank {int(rank)}")
+            except (TypeError, ValueError):
+                pass
+        elif s.get("tname"):
             threads.setdefault(tid, str(s["tname"]))
         args = {k: s[k] for k in ("span_id", "parent_id", "bytes",
-                                  "child_bytes")
+                                  "child_bytes", "trace_id")
                 if s.get(k) is not None}
-        args.update(s.get("labels") or {})
-        trace.append({"name": str(s.get("name", "?")), "cat": "span",
-                      "ph": "X", "ts": _us(s.get("start", 0.0)),
-                      "dur": _us(s["dur"]), "pid": pid, "tid": tid,
-                      "args": args})
+        args.update(labels)
+        entry = {"name": str(s.get("name", "?")), "cat": "span",
+                 "ph": "X", "ts": _us(s.get("start", 0.0)),
+                 "dur": _us(s["dur"]), "pid": pid, "tid": tid,
+                 "args": args}
+        trace.append(entry)
+        for t in (s.get("trace_id") or ()):
+            flows.setdefault(str(t), []).append(entry)
     # counter-track state: each "C" event's args define ALL series values
     # at that timestamp, so the missing series must be carried forward or
     # the renderer drops its line to zero between samples
@@ -68,6 +91,23 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
         name = e.get("name")
         args = {k: v for k, v in e.items()
                 if k not in _EVENT_META and v is not None}
+        if cat == "gauge" and e.get("value") is not None:
+            # journaled gauges (serve queue depth, admission token
+            # levels, elastic live devices, ...) reconstruct as counter
+            # ("C") tracks — one track per gauge name + label set (the
+            # span/trace stamps a gauge event happens to carry are
+            # provenance, not series identity)
+            cname = str(name or "gauge")
+            labels = {k: v for k, v in args.items()
+                      if k not in ("value", "span_id", "trace_id")}
+            if labels:
+                cname += "{" + ",".join(
+                    f"{k}={labels[k]}" for k in sorted(labels)) + "}"
+            trace.append({"name": cname, "cat": "gauge", "ph": "C",
+                          "ts": _us(e.get("t", 0.0)), "dur": 0,
+                          "pid": pid, "tid": 0,
+                          "args": {"value": e["value"]}})
+            continue
         trace.append({"name": f"{cat}/{name}" if name is not None else cat,
                       "cat": cat, "ph": "i", "s": "t",
                       "ts": _us(e.get("t", 0.0)), "dur": 0,
@@ -87,6 +127,19 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
                               "dur": 0, "pid": pid, "tid": 0,
                               "args": {"live": hbm_live,
                                        "staging": hbm_staging}})
+    for flow_n, (tid_key, entries) in enumerate(sorted(flows.items())):
+        if len(entries) < 2:
+            continue                  # a flow needs two ends
+        entries.sort(key=lambda e: e["ts"])
+        for i, entry in enumerate(entries):
+            ph = "s" if i == 0 else ("f" if i == len(entries) - 1 else "t")
+            ev = {"name": "request", "cat": "trace", "ph": ph,
+                  "id": flow_n + 1, "ts": entry["ts"], "dur": 0,
+                  "pid": entry["pid"], "tid": entry["tid"],
+                  "args": {"trace_id": tid_key}}
+            if ph == "f":
+                ev["bp"] = "e"        # bind the finish to the slice start
+            trace.append(ev)
     for tid, tname in sorted(threads.items()):
         trace.append({"name": "thread_name", "ph": "M", "ts": 0, "dur": 0,
                       "pid": pid, "tid": tid, "args": {"name": tname}})
@@ -191,6 +244,21 @@ def to_prometheus(registry: dict | None = None) -> str:
     for key, h in sorted(registry.get("histograms", {}).items()):
         name, labels = _split_key(key)
         base = _metric_name(name)
+        if "buckets" in h:
+            # bucketed entry (core.observe(..., buckets=...)): a real
+            # Prometheus histogram — cumulative le series + count/sum.
+            # The serving SLO families (da_tpu_serve_slo_*) land here.
+            f = fam(base, "histogram", f"histogram {name}")
+            bounds = sorted(float(b) for b in h.get(
+                "bounds", [float(k) for k in h["buckets"] if k != "+Inf"]))
+            cum = 0
+            for b in bounds:
+                cum += int(h["buckets"].get(str(float(b)), 0))
+                f.add({**labels, "le": f"{b:g}"}, cum, "_bucket")
+            f.add({**labels, "le": "+Inf"}, h.get("count", 0), "_bucket")
+            f.add(labels, h.get("count", 0), "_count")
+            f.add(labels, h.get("total", 0.0), "_sum")
+            continue
         f = fam(base, "summary", f"summary {name}")
         f.add(labels, h.get("count", 0), "_count")
         f.add(labels, h.get("total", 0.0), "_sum")

@@ -42,7 +42,8 @@ from collections import deque
 from . import core
 
 __all__ = ["Span", "span", "traced", "current_span", "current_span_id",
-           "spans", "span_stats", "open_spans"]
+           "spans", "span_stats", "open_spans", "annotate", "trace_ctx",
+           "current_trace_ids", "bind_trace_ids", "record_external_span"]
 
 _SPAN_BUFFER_MAX = 8192
 _ids = itertools.count(1)        # CPython-atomic; no lock needed
@@ -63,12 +64,16 @@ class Span:
 
     __slots__ = ("name", "labels", "span_id", "parent_id", "parent",
                  "start", "_t0", "dur", "bytes", "child_s", "child_bytes",
-                 "tid", "tname", "journaled")
+                 "tid", "tname", "journaled", "trace")
 
     def __init__(self, name: str, labels: dict, parent: "Span | None",
                  journaled: bool = True):
         self.name = name
         self.labels = labels
+        # request-scoped trace ids: every span opened while a trace
+        # context is set carries them — submit-to-resolve journeys
+        # reconstruct from the journal (and export as Perfetto flows)
+        self.trace = core._TRACE_CTX.get()
         self.span_id = next(_ids)
         self.parent = parent
         self.parent_id = parent.span_id if parent is not None else None
@@ -96,6 +101,8 @@ class Span:
              "tid": self.tid, "tname": self.tname}
         if self.labels:
             d["labels"] = dict(self.labels)
+        if self.trace:
+            d["trace_id"] = list(self.trace)
         return d
 
     def __repr__(self):
@@ -181,6 +188,8 @@ def _finish(sp: Span, journal: bool, error: bool = False) -> None:
                   "tid": sp.tid, "tname": sp.tname}
         if sp.labels:
             fields["labels"] = sp.labels
+        if sp.trace:
+            fields["trace_id"] = list(sp.trace)
         if error:
             fields["error"] = True
         core.event("span", sp.name, **fields)
@@ -212,6 +221,115 @@ def traced(fn=None, *, name: str | None = None, _journal: bool = True,
 def current_span() -> Span | None:
     """The innermost open span on this thread/context, or None."""
     return core._CURRENT_SPAN.get()
+
+
+def annotate(**labels) -> None:
+    """Merge ``labels`` into the innermost open span — for call sites
+    whose interesting labels (shapes, analytic cost stamps) only exist
+    after the span opened (e.g. a ``@traced`` function that derives its
+    operand shapes in its body).  No-op when telemetry is disabled or no
+    span is open."""
+    if not core._ENABLED:
+        return
+    sp = core._CURRENT_SPAN.get()
+    if sp is None:
+        return
+    with core._LOCK:
+        # fresh dict: the span CM may share its labels dict across
+        # re-entries of the same context-manager object
+        sp.labels = {**sp.labels, **labels}
+
+
+class trace_ctx:
+    """Context manager binding one or more request trace ids to the
+    current context: every span opened (and journal event recorded)
+    inside carries them.  Nesting unions the ids (a batch dispatch holds
+    every member request's id).  Single boolean check when disabled."""
+
+    __slots__ = ("_ids", "_tok")
+
+    def __init__(self, *ids):
+        self._ids = tuple(str(i) for i in ids if i)
+        self._tok = None
+
+    def __enter__(self):
+        if not core._ENABLED or not self._ids:
+            return None
+        cur = core._TRACE_CTX.get() or ()
+        merged = cur + tuple(i for i in self._ids if i not in cur)
+        self._tok = core._TRACE_CTX.set(merged)
+        return merged
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._tok is not None:
+            core._TRACE_CTX.reset(self._tok)
+            self._tok = None
+        return False
+
+
+def current_trace_ids() -> tuple:
+    """The trace ids bound to the current context (empty tuple when
+    none) — capture these before handing work to another thread and
+    rebind there with :class:`trace_ctx` or :func:`bind_trace_ids`
+    (contextvars do not cross thread starts)."""
+    return core._TRACE_CTX.get() or ()
+
+
+def bind_trace_ids(ids) -> None:
+    """Bind ``ids`` to THIS context with no reset token — for the entry
+    point of a worker thread whose context dies with it (SPMD rank
+    tasks).  Use :class:`trace_ctx` anywhere the context outlives the
+    work."""
+    if ids and core._ENABLED:
+        core._TRACE_CTX.set(tuple(str(i) for i in ids))
+
+
+def record_external_span(name: str, start: float, dur: float, *,
+                         labels: dict | None = None, tid: int = 0,
+                         tname: str = "", error: bool = False) -> None:
+    """Record a span measured OUTSIDE this process's tracing machinery —
+    e.g. a forked SPMD rank child measures its own step and ships the
+    interval home; the parent records it here so both backends produce
+    rank-labeled ``spmd.step`` spans.  ``start`` is seconds relative to
+    the telemetry origin (``core._T0`` — inherited across fork), ``dur``
+    in seconds.  Stamped with the caller's trace context."""
+    global _finished_total
+    if not core._ENABLED:
+        return
+    # a root span, like the thread backend's rank steps (fresh threads
+    # have no contextvar parent): concurrent rank durations must not
+    # roll up into one parent's child time and drive its self time
+    # negative
+    sp = Span(name, dict(labels or {}), None)
+    sp.start = float(start)
+    sp.dur = float(dur)
+    if tid:
+        sp.tid = tid
+    if tname:
+        sp.tname = tname
+    with core._LOCK:
+        _finished.append(sp.to_dict())
+        _finished_total += 1
+        st = _stats.get(sp.name)
+        if st is None:
+            _stats[sp.name] = {"count": 1, "total_s": sp.dur,
+                               "self_s": sp.dur, "bytes": 0,
+                               "child_bytes": 0}
+        else:
+            st["count"] += 1
+            st["total_s"] += sp.dur
+            st["self_s"] += sp.dur
+    fields = {"span_id": sp.span_id, "parent_id": sp.parent_id,
+              "start": round(sp.start, 6), "dur": round(sp.dur, 6),
+              "bytes": 0, "child_bytes": 0, "tid": sp.tid,
+              "tname": sp.tname}
+    if sp.labels:
+        fields["labels"] = sp.labels
+    if sp.trace:
+        fields["trace_id"] = list(sp.trace)
+    if error:
+        fields["error"] = True
+    core.event("span", sp.name, **fields)
 
 
 def current_span_id() -> int | None:

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Scripted telemetry workload for the performance-observatory gates.
+
+Runs a small but representative slice of the framework — h2d distribute,
+a distributed GEMM, an RDMA-armed (interpret-mode) single-axis reshard
+NEXT TO its XLA twin, a serve round trip over an SPMD endpoint, a
+mapreduce, and a d2h gather — with the journal enabled, so
+
+    python tools/perf_workload.py /tmp/journal.jsonl
+    python -m distributedarrays_tpu.telemetry doctor /tmp/journal.jsonl \
+        --min-findings 1
+
+exercises the whole doctor pipeline (roofline classification, the
+rdma-vs-xla reshard overlap comparison, request-trace flows, ranked
+findings).  Shared by the CI observability leg and
+tests/test_perf.py's CLI round-trip, so the acceptance workload cannot
+drift between the two.
+"""
+
+import os
+import sys
+
+if len(sys.argv) != 2:
+    print("usage: perf_workload.py JOURNAL_PATH", file=sys.stderr)
+    sys.exit(2)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["DA_TPU_TELEMETRY"] = "1"
+os.environ["DA_TPU_TELEMETRY_JOURNAL"] = sys.argv[1]
+os.environ.setdefault("DA_TPU_RDMA", "0")     # armed per-phase below
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import _cpu_harness  # noqa: E402
+
+_cpu_harness.force_cpu_mesh()
+
+import numpy as np  # noqa: E402
+
+import distributedarrays_tpu as dat  # noqa: E402
+from distributedarrays_tpu.parallel import spmd_mode as sm  # noqa: E402
+from distributedarrays_tpu.serve import Server, ServeConfig  # noqa: E402
+
+# -- h2d + distributed GEMM (cost-stamped matmul span) ----------------------
+A = dat.distribute(np.arange(64 * 64, dtype=np.float32).reshape(64, 64))
+B = dat.distribute(np.ones((64, 64), dtype=np.float32))
+C = A @ B
+
+# -- the RDMA-armed (interpret) reshard vs its XLA twin ---------------------
+# an eligible single-axis repartition: (8,1) -> (1,8) lowers to the
+# planner's compiled all_to_all; DA_TPU_RDMA flips which ring runs and
+# the reshard span carries dispatch=rdma|xla + the bytes_ici stamp
+src = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+for dispatch in ("interpret", "0"):
+    os.environ["DA_TPU_RDMA"] = dispatch
+    E = dat.distribute(src, dist=(8, 1))
+    F = dat.dzeros((64, 64), dist=(1, 8))
+    dat.copyto_(F, E)
+    assert np.array_equal(dat.gather(F), src), dispatch
+    E.close()
+    F.close()
+os.environ["DA_TPU_RDMA"] = "0"
+
+# -- serve round trip: trace ids submit -> dispatch -> rank steps -----------
+srv = Server(ServeConfig(max_batch=4, flush_s=0.002))
+
+
+def endpoint(payloads):
+    out = []
+    for p in payloads:
+        ranks = sm.spmd(lambda: sm.myid(), pids=[0, 1])
+        out.append(float(np.sum(p)) + float(sum(ranks)))
+    return out
+
+
+srv.register("echo", endpoint)
+futs = [srv.submit("echo", np.full((8, 8), i, dtype=np.float32),
+                   tenant=f"t{i % 2}") for i in range(4)]
+results = [f.result(timeout=60) for f in futs]
+srv.close()
+
+# -- mapreduce + gather -----------------------------------------------------
+total = dat.dreduce("sum", A)
+g = dat.gather(C)
+
+for d in (A, B, C):
+    d.close()
+dat.d_closeall()
+print("perf-workload-ok", len(results), float(np.asarray(total)))

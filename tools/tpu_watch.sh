@@ -83,6 +83,25 @@ print('elastic-smoke-ok', len(ranks), 'devices')
       else
         echo "(no telemetry journal produced)" >> "$LOG"
       fi
+      # Regression sentinel BEFORE celebrating: judge the fresh headline
+      # row against the banked BENCH_r* trajectory (noise-aware MAD
+      # thresholds; replayed rows excluded on both sides).  A banked
+      # regression fails the watch loudly (rc=1 + marker file) instead of
+      # silently extending the table.
+      echo "=== regression sentinel (telemetry regress) ===" >> "$LOG"
+      REGRESSED=0
+      timeout 120 python -m distributedarrays_tpu.telemetry regress \
+          /root/repo/tools/bench_out.json --baseline /root/repo \
+          >> "$LOG" 2>&1
+      regress_rc=$?
+      if [ $regress_rc -eq 1 ]; then
+        REGRESSED=1
+        echo "=== REGRESSION FLAGGED — fresh row significantly slower than the banked trajectory ===" >> "$LOG"
+        echo "REGRESSION" > /root/repo/tools/tpu_watch.regression
+      else
+        rm -f /root/repo/tools/tpu_watch.regression
+        echo "=== regress rc=$regress_rc (0=ok, 2=nothing judgeable) ===" >> "$LOG"
+      fi
       echo "=== RDMA vs XLA (pallas_collectives) ===" >> "$LOG"
       timeout 60 python - >> "$LOG" 2>&1 <<'PYEOF'
 import json
@@ -101,7 +120,7 @@ PYEOF
       DAT_TEST_TPU=1 timeout 1800 python -m pytest tests/test_tpu_compiled.py -q >> "$LOG" 2>&1
       echo "=== tpu tests rc=$? $(date -u) ===" >> "$LOG"
       echo "DONE" > /root/repo/tools/tpu_watch.done
-      exit 0
+      exit $REGRESSED
     fi
     echo "=== bench did not bank, continuing probes ===" >> "$LOG"
   fi
