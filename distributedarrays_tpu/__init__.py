@@ -38,5 +38,6 @@ from . import parallel
 from . import resilience
 from . import serve
 from . import telemetry
+from . import train
 
 __version__ = "0.1.0"

@@ -49,6 +49,15 @@ authoritative list):
                           reshard (labels: ``strategy``, ``op``)
 ``checkpoint.write``      between payload write and publish-marker write
                           (labels: ``store``)
+``checkpoint.read``       after the payload arrays are read off disk, before
+                          integrity verification (labels: ``store``,
+                          ``path``) — where the ``corrupt`` action flips
+                          bytes
+``train.step``            controller-side, at the top of every trainer step
+                          (labels: ``step``, ``epoch``)
+``grad.sync``             between the per-rank gradient computation and the
+                          gradient-sync/update program of a trainer step
+                          (labels: ``step``)
 ========================  ====================================================
 
 Plan format (``DA_TPU_FAULT_PLAN`` — inline JSON, or a path to a JSON
@@ -60,11 +69,17 @@ file): a list of spec objects::
 ``action``: ``raise`` (InjectedFault), ``device_loss`` (InjectedDeviceLoss
 + the device joins the simulated-down set until ``revive_after`` elastic
 probes have passed), ``hang`` (sleep ``hang_s`` — drives receive
-timeouts), ``exit`` (``os._exit`` in forked ranks: death without a
-report; degrades to ``raise`` in-process).  ``at`` is the 1-based
-matching-invocation index of the first firing, ``count`` how many
-consecutive matching invocations fire (``-1`` = forever), ``p`` an
-optional seeded per-invocation firing probability.
+timeouts and straggler budgets; a hang spec with an explicit ``device``
+ALSO joins that device to the simulated-down set, modelling a device
+that goes quiet and is then found dead by a health probe), ``exit``
+(``os._exit`` in forked ranks: death without a report; degrades to
+``raise`` in-process), ``corrupt`` (no exception at the site — the
+caller applies seeded byte-flips to its payload via
+:func:`corrupt_arrays`; the checkpoint read path is the consumer).
+``at`` is the 1-based matching-invocation index of the first firing,
+``count`` how many consecutive matching invocations fire (``-1`` =
+forever), ``p`` an optional seeded per-invocation firing probability,
+``flips`` how many payload bytes a ``corrupt`` firing inverts.
 
 Seed: ``DA_TPU_FAULT_SEED`` (or ``configure(seed=...)``); also feeds
 :func:`jitter`, so retry backoff in ``recovery`` is reproducible under a
@@ -87,6 +102,7 @@ __all__ = [
     "InjectedFault", "InjectedDeviceLoss", "FaultSpec",
     "configure", "clear", "active", "check", "decide", "act",
     "history", "simulated_down", "probe_tick", "revive", "jitter",
+    "corrupt_arrays",
 ]
 
 _SEED_ENV = "DA_TPU_FAULT_SEED"
@@ -131,6 +147,7 @@ class FaultSpec:
     revive_after: int | None = None      # elastic probes until auto-revive
     hang_s: float = 0.2
     p: float | None = None               # seeded firing probability
+    flips: int = 8                       # bytes inverted by "corrupt"
     index: int = 0                       # position in the plan (set on load)
 
     @classmethod
@@ -142,7 +159,8 @@ class FaultSpec:
                              f"(known: {sorted(known - {'index'})})")
         spec = cls(**{k: v for k, v in d.items() if k != "index"})
         spec.index = index
-        if spec.action not in ("raise", "device_loss", "hang", "exit"):
+        if spec.action not in ("raise", "device_loss", "hang", "exit",
+                               "corrupt"):
             raise ValueError(f"unknown fault action {spec.action!r}")
         if spec.at < 1:
             raise ValueError(f"fault spec 'at' is 1-based, got {spec.at}")
@@ -198,6 +216,12 @@ class _Injector:
                         else labels.get("rank")
                     if dev is not None:
                         self.down[int(dev)] = spec.revive_after
+                elif spec.action == "hang" and spec.device is not None:
+                    # a hang spec naming a device models "goes quiet,
+                    # then found dead": the site only sleeps, but the
+                    # next elastic probe sees the device down — the
+                    # straggler-detection scenario
+                    self.down[int(spec.device)] = spec.revive_after
                 return spec
         return None
 
@@ -302,6 +326,11 @@ def act(spec: FaultSpec | None, labels: dict | None = None) -> None:
         return
     if spec.action == "device_loss":
         raise InjectedDeviceLoss(spec, labels)
+    if spec.action == "corrupt":
+        # payload-targeted action: the site applies the byte flips itself
+        # via corrupt_arrays(); at a site that never consumes it the
+        # firing is a recorded no-op, not an exception
+        return
     if spec.action == "exit":
         # only meaningful in a forked SPMD rank: die without reporting.
         # In the controller process this degrades to a raise — killing
@@ -318,6 +347,42 @@ def check(site: str, **labels) -> None:
     if _injector is None and _env_checked:
         return
     act(decide(site, **labels), labels)
+
+
+def corrupt_arrays(spec: FaultSpec, arrays: dict) -> dict:
+    """Apply a fired ``corrupt`` spec to a checkpoint payload: pick one
+    array (seeded) and invert ``spec.flips`` of its bytes at seeded
+    offsets.  Returns a new dict whose corrupted entry is a fresh copy —
+    caller-held buffers are never mutated.  Deterministic: the draw is a
+    pure function of ``(seed, spec.index, firing number)``, so a chaos
+    replay corrupts the exact same bytes."""
+    import numpy as _np
+    inj = _current()
+    if inj is None or not arrays:
+        return arrays
+    with inj.lock:
+        n = inj.counts.get(spec.index, 0)      # the firing this applies to
+    rng = _random.Random(_mix(inj.seed, spec.index + 100_003, n))
+    keys = sorted(arrays)
+    key = keys[rng.randrange(len(keys))]
+    arr = _np.asarray(arrays[key])
+    if arr.nbytes == 0:
+        return arrays
+    buf = bytearray(arr.tobytes())
+    # distinct offsets: drawing with replacement could XOR the same
+    # byte twice and cancel, making a "fired" corruption a no-op
+    nflips = min(max(1, int(spec.flips)), len(buf))
+    for off in rng.sample(range(len(buf)), nflips):
+        buf[off] ^= 0xFF
+    bad = _np.frombuffer(bytes(buf), dtype=arr.dtype).reshape(arr.shape)
+    out = dict(arrays)
+    out[key] = bad
+    _tm.count("faults.corruptions")
+    if _tm.enabled():
+        # cold path: a firing corruption is an exceptional event by design
+        _tm.event("faults", "corrupt", key=key, flips=nflips,
+                  spec=spec.index)
+    return out
 
 
 def history() -> list[dict]:

@@ -60,7 +60,16 @@ _TIMEOUT_MARKS = ("timed out", "TimeoutError")
 class RetryPolicy:
     """Bounds for the retry loop.  ``max_retries`` counts *retries* (total
     attempts = max_retries + 1); ``timeout_retries`` caps the
-    fresh-mesh path separately (default: once, per the decision table)."""
+    fresh-mesh path separately (default: once, per the decision table).
+
+    ``max_elapsed_s`` is a *wall-clock* budget over the whole
+    ``run_with_recovery`` call (attempts + restores + backoff): once it
+    is spent, the pending failure re-raises instead of retrying, and a
+    backoff sleep is always clamped to the remaining budget — a retry
+    loop under a per-step deadline (the trainer's, or a serve dispatch
+    SLO) never sleeps past it.  The budget is checked *between*
+    attempts; a single attempt that overruns it is not preempted (use
+    the caller's own timeout machinery for that)."""
 
     max_retries: int = 3
     timeout_retries: int = 1
@@ -68,11 +77,16 @@ class RetryPolicy:
     backoff: float = 2.0
     max_delay: float = 2.0
     jitter: float = 0.5          # fraction of the delay added as jitter
+    max_elapsed_s: float | None = None   # wall-clock budget for the loop
 
-    def delay(self, retry_index: int) -> float:
+    def delay(self, retry_index: int, remaining_s: float | None = None) \
+            -> float:
         d = min(self.base_delay * self.backoff ** retry_index,
                 self.max_delay)
-        return d * (1.0 + faults.jitter(self.jitter))
+        d = d * (1.0 + faults.jitter(self.jitter))
+        if remaining_s is not None:
+            d = min(d, max(remaining_s, 0.0))
+        return d
 
 
 def _chain(exc: BaseException):
@@ -170,6 +184,13 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
     """
     pol = policy or RetryPolicy()
     devs = devices if devices is not None else elastic.manager()
+    t_start = time.monotonic()
+
+    def _remaining():
+        if pol.max_elapsed_s is None:
+            return None
+        return pol.max_elapsed_s - (time.monotonic() - t_start)
+
     timeout_retries = 0
     attempt = 0
     while True:
@@ -196,7 +217,10 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             _tm.count("recovery.failures", verdict=verdict)
             retries_used = attempt - 1
             interrupted = stop_event is not None and stop_event.is_set()
+            remaining = _remaining()
+            deadline_spent = remaining is not None and remaining <= 0
             retryable = (not interrupted
+                         and not deadline_spent
                          and verdict != "divergence"
                          and retries_used < pol.max_retries
                          and not (verdict == "timeout"
@@ -204,6 +228,8 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                                   >= pol.timeout_retries))
             if interrupted:
                 _tm.count("recovery.interrupted", verdict=verdict)
+            if deadline_spent:
+                _tm.count("recovery.deadline_exceeded", verdict=verdict)
             if _tm.enabled():
                 # cold path: one event per failed attempt
                 _tm.event("recovery", "failure", verdict=verdict,
@@ -220,14 +246,20 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             if checkpoints is not None and restore_fn is not None:
                 try:
                     state = checkpoints.restore()
-                except FileNotFoundError:
+                except FileNotFoundError as fe:
                     # distinguish "nothing saved yet" (a failure before
                     # the first save() completes — retry from live
-                    # state) from "steps exist but NONE loads" (the
+                    # state) from "steps exist(ed) but NONE loads" (the
                     # unreadable-checkpoint condition must surface, not
-                    # silently degrade to live-state retry)
+                    # silently degrade to live-state retry).  A chained
+                    # cause means restore FOUND steps and every load
+                    # failed — that check must come first, because the
+                    # integrity layer QUARANTINES corrupt steps as it
+                    # goes, so by the time we look, steps() can already
+                    # be empty for an every-step-corrupt store
                     steps = getattr(checkpoints, "steps", None)
-                    if steps is not None and steps():
+                    if fe.__cause__ is not None or \
+                            (steps is not None and steps()):
                         raise
                     _tm.count("recovery.restore_skipped")
                     state = None
@@ -238,11 +270,22 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                 # shrink AFTER the restore so freshly restored arrays
                 # land on survivors too
                 devs.shrink()
+            # restore/shrink themselves take wall time: re-check the
+            # budget before launching a fresh attempt, or a slow
+            # restore would let the attempt start arbitrarily far past
+            # the deadline the caller set
+            remaining = _remaining()
+            if remaining is not None and remaining <= 0:
+                _tm.count("recovery.deadline_exceeded", verdict=verdict)
+                _tm.count("recovery.giveups", verdict=verdict)
+                raise
             # interruptible backoff: a drain/shutdown signal wakes the
             # sleep promptly and abandons the retry with the pending
             # failure — a draining server must never sit out an
-            # exponential delay before it can finish
-            delay = pol.delay(retries_used)
+            # exponential delay before it can finish.  Under a
+            # max_elapsed_s budget the sleep is clamped to what remains
+            # (restore/shrink above may have consumed some of it).
+            delay = pol.delay(retries_used, remaining)
             if stop_event is None:
                 time.sleep(delay)
             elif stop_event.wait(delay):

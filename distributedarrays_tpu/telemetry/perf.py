@@ -36,10 +36,10 @@ import os
 __all__ = [
     "DEFAULT_PEAKS", "peaks_for", "platform_alias",
     "gemm_cost", "reshard_cost", "attention_cost", "reduce_cost",
-    "transfer_cost",
+    "transfer_cost", "train_step_cost",
     "span_cost", "classify_occurrence", "classify", "coverage",
     "overlap_stats", "interval_overlap", "timeline_overlap",
-    "critical_path", "analyze",
+    "train_step_overlap", "critical_path", "analyze",
 ]
 
 PEAKS_ENV = "DA_TPU_PEAKS"
@@ -179,6 +179,26 @@ def reduce_cost(n_elems: int, itemsize: int = 4, *,
     sweep HBM-bound exactly when it should be)."""
     return {"flops": int(n_elems) * int(flops_per_elem),
             "bytes_hbm": int(n_elems) * int(itemsize), "bytes_ici": 0}
+
+
+def train_step_cost(n_params: int, p: int, *, flops: float = 0.0,
+                    batch_bytes: int = 0, itemsize: int = 4,
+                    nslots: int = 2) -> dict:
+    """Stamp for one data-parallel ZeRO-1 training step over ``p``
+    ranks: the gradient sync is one ring all-gather of the parameter
+    shards plus one ring reduce-scatter of the full gradients —
+    aggregate ICI volume ``2 (p-1) n_params itemsize`` — and the HBM
+    floor is ``3 + 2 nslots`` parameter-vector passes (read params,
+    grads and each optimizer moment; write params and each moment —
+    7 passes for Adam's two moments, 3 for plain SGD) plus the batch
+    read once.  ``flops`` is the task's fwd+bwd estimate (aggregate,
+    like every stamp)."""
+    n = int(n_params) * int(itemsize)
+    return {
+        "flops": float(flops),
+        "bytes_hbm": (3 + 2 * int(nslots)) * n + int(batch_bytes),
+        "bytes_ici": 2 * (int(p) - 1) * n if p > 1 else 0,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -437,6 +457,50 @@ def timeline_overlap(events: list) -> list:
     return out
 
 
+def train_step_overlap(events: list) -> list:
+    """Measured grad-sync overlap per *training step*: every
+    ``train.step`` span's children split comm/compute (the trainer
+    labels ``train.sync`` ``kind=comm`` and ``train.grad``
+    ``kind=compute``; rank-skewed children on other threads join the
+    same step) and the unions intersect.  One entry per step, in step
+    order, carrying the step index / rank count / dispatch labels so
+    the doctor can print a per-step trajectory — including steps whose
+    sync never overlapped anything (overlap_frac 0.0), which is the
+    finding."""
+    spans, children, _ = _span_forest(events)
+    out = []
+    for pid, kids in children.items():
+        parent = spans[pid]
+        if parent.get("name") != "train.step":
+            continue
+        comm, compute = [], []
+        for sid in kids:
+            e = spans[sid]
+            iv = (float(e.get("start", 0.0)),
+                  float(e.get("start", 0.0)) + float(e["dur"]))
+            kind = _span_kind(e)
+            if kind == "comm":
+                comm.append(iv)
+            elif kind == "compute":
+                compute.append(iv)
+        if not comm:
+            continue
+        labels = parent.get("labels") or {}
+        entry = {"step": labels.get("step"), "span_id": pid,
+                 "dur": float(parent["dur"]),
+                 "ranks": labels.get("ranks"),
+                 "dispatch": labels.get("dispatch")}
+        entry.update(interval_overlap(comm, compute))
+        out.append(entry)
+    def _step_key(e):
+        try:
+            return (0, int(e["step"]))
+        except (TypeError, ValueError):
+            return (1, e["span_id"])
+    out.sort(key=_step_key)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # critical path
 # ---------------------------------------------------------------------------
@@ -549,6 +613,7 @@ def analyze(events: list, peaks: dict | None = None,
         overlap_stats(e, peaks) for e in events if e.get("cat") == "span")
         if s is not None]
     measured = timeline_overlap(events)
+    train_steps = train_step_overlap(events)
     cpath = critical_path(events)
     findings = []
     for ov in overlaps:
@@ -599,6 +664,7 @@ def analyze(events: list, peaks: dict | None = None,
         "classified": classified,
         "overlap": overlaps,
         "measured_overlap": measured,
+        "train_steps": train_steps,
         "critical_path": cpath,
         "findings": findings,
     }
@@ -636,6 +702,15 @@ def format_analysis(a: dict, out) -> None:
         for ov in a["measured_overlap"]:
             out.write(f"  {ov['step']:<28} overlap {ov['overlap_frac']:.2f}"
                       f"  unoverlapped {ov['unoverlapped_s']:.6f}s\n")
+    if a.get("train_steps"):
+        out.write("\ngrad-sync overlap per training step:\n")
+        for ov in a["train_steps"]:
+            tag = f"[{ov['dispatch']}]" if ov.get("dispatch") else ""
+            ranks = f" p={ov['ranks']}" if ov.get("ranks") else ""
+            out.write(f"  step {str(ov['step']):<6}{tag}{ranks}  "
+                      f"sync {ov['comm_s']:.6f}s  overlap "
+                      f"{ov['overlap_frac']:.2f}  unoverlapped "
+                      f"{ov['unoverlapped_s']:.6f}s\n")
     if a["critical_path"]:
         out.write("\ncritical path (longest root):\n")
         for s in a["critical_path"]:

@@ -1,0 +1,17 @@
+"""Fault-tolerant elastic training over sharded DArrays.
+
+``Trainer`` runs data-parallel SGD/Adam with ZeRO-1 sharded state,
+ring-collective gradient sync, per-step recovery deadlines, straggler
+detection, and integrity-verified checkpoint resume — see
+:mod:`.trainer` and docs/training.md.
+"""
+
+from .optim import Optimizer, adam, sgd
+from .tasks import TrainTask, mlp_task, transformer_task
+from .trainer import DeadRankError, StragglerDetector, Trainer
+
+__all__ = [
+    "Trainer", "StragglerDetector", "DeadRankError",
+    "Optimizer", "adam", "sgd",
+    "TrainTask", "mlp_task", "transformer_task",
+]

@@ -1,0 +1,96 @@
+"""Flat-vector optimizers for the data-parallel trainer.
+
+The trainer (:mod:`.trainer`) keeps model parameters as ONE flat f32
+vector sharded over the data-parallel ranks (the ZeRO-1 layout: each
+rank owns — and updates — only its slice of the parameters and of every
+optimizer moment).  Optimizers here are therefore *elementwise* pure
+functions over flat slices: the update at index ``i`` depends only on
+``p[i]``, ``g[i]`` and the moments at ``i``, so the exact same code is
+correct on a full vector, a shard, or a padded shard (padding rows carry
+zero gradients and provably stay zero — see :meth:`Optimizer.update`).
+
+Two members cover the repo's training workloads: plain/momentum SGD and
+Adam.  Hyperparameters live on the (hashable, frozen) spec so a trainer
+program cache can key on them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["Optimizer", "sgd", "adam"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """One optimizer spec: ``kind`` ∈ {"sgd", "adam"} plus hyperparams.
+
+    ``nslots`` moment buffers ride next to the parameter vector (same
+    shape, same sharding): 0 for plain SGD, 1 for momentum SGD, 2 for
+    Adam.  :meth:`update` is traced inside the trainer's shard_map
+    program; :meth:`init_slots` runs on the host at state creation.
+    """
+
+    kind: str = "adam"
+    lr: float = 1e-3
+    momentum: float = 0.0        # sgd only
+    b1: float = 0.9              # adam
+    b2: float = 0.999            # adam
+    eps: float = 1e-8            # adam
+
+    def __post_init__(self):
+        if self.kind not in ("sgd", "adam"):
+            raise ValueError(f"unknown optimizer kind {self.kind!r} "
+                             "(use 'sgd' or 'adam')")
+
+    @property
+    def nslots(self) -> int:
+        if self.kind == "adam":
+            return 2
+        return 1 if self.momentum else 0
+
+    def init_slots(self, n: int) -> tuple:
+        """Zero moment vectors for an ``n``-element parameter slice."""
+        return tuple(np.zeros(n, dtype=np.float32)
+                     for _ in range(self.nslots))
+
+    def update(self, t, p, g, slots: tuple) -> tuple:
+        """One elementwise step: ``(p, *slots), g -> (p', *slots')``.
+
+        ``t`` is the 1-based step number (traced scalar — Adam's bias
+        correction; a retraced program per step would defeat the jit
+        cache).  A zero gradient is a provable fixed point for every
+        member (Adam: m=v=0 ⇒ update 0/(0+eps)=0), which is what makes
+        the trainer's shard padding safe.
+        """
+        lr = jnp.float32(self.lr)
+        if self.kind == "sgd":
+            if not self.momentum:
+                return (p - lr * g,)
+            (m,) = slots
+            m2 = jnp.float32(self.momentum) * m + g
+            return p - lr * m2, m2
+        m, v = slots
+        b1, b2 = jnp.float32(self.b1), jnp.float32(self.b2)
+        t = t.astype(jnp.float32)
+        m2 = b1 * m + (1.0 - b1) * g
+        v2 = b2 * v + (1.0 - b2) * jnp.square(g)
+        mhat = m2 / (1.0 - jnp.power(b1, t))
+        vhat = v2 / (1.0 - jnp.power(b2, t))
+        return (p - lr * mhat / (jnp.sqrt(vhat) + jnp.float32(self.eps)),
+                m2, v2)
+
+
+def sgd(lr: float = 1e-2, momentum: float = 0.0) -> Optimizer:
+    """Plain (or momentum) SGD over the flat parameter vector."""
+    return Optimizer(kind="sgd", lr=lr, momentum=momentum)
+
+
+def adam(lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8) -> Optimizer:
+    """Adam with bias correction; two sharded moment vectors."""
+    return Optimizer(kind="adam", lr=lr, b1=b1, b2=b2, eps=eps)

@@ -1,0 +1,553 @@
+"""Chaos-survivable data-parallel trainer over sharded DArrays.
+
+The workload half of ROADMAP item 1: SGD/Adam training whose every
+moving part is owned by the subsystems the previous PRs built, so one
+long-running stateful job finally exercises them together —
+
+- **State lives in DArrays.**  The parameters are ONE flat f32 vector
+  (ZeRO-1 layout), sharded over the data-parallel ranks together with
+  every optimizer moment; each epoch's batch is sharded the same way via
+  ``distribute``.  Because they are ordinary registered DArrays,
+  ``elastic.shrink()`` re-lays parameters, optimizer state AND batch
+  shards onto the survivors through the reshard planner — the trainer
+  adds no relocation code of its own.
+- **Gradient sync rides the PR 8 ring kernels.**  Inside one
+  ``jit(shard_map)`` program per rank count: ``ring_all_gather`` fans the
+  parameter shards out, ``jax.grad`` runs per rank on the local batch
+  shard, and ``ring_reduce_scatter`` returns each rank exactly its slice
+  of the summed gradient (both kernels fall back to the bit-equivalent
+  ``lax`` collectives off-TPU, so the program is identical on the CPU
+  test mesh).
+- **Every step runs under ``recovery.run_with_recovery``** with a
+  per-step wall-clock deadline (``RetryPolicy.max_elapsed_s``).  A
+  device-loss verdict restores the last published checkpoint
+  (integrity-verified — a corrupt shard quarantines and falls back),
+  shrinks onto survivors, and deterministically recomputes from the
+  restored step; the rewind also discards now-stale later checkpoints
+  (``CheckpointManager.discard_from``) so no future restore can
+  resurrect the abandoned timeline.
+- **Straggler detection**: completed step durations feed a rolling
+  p99-derived budget; a step that exceeds it triggers an elastic health
+  probe, and a probe-confirmed dead rank raises :class:`DeadRankError`
+  (classified ``device_loss``) BEFORE the step's update is applied — the
+  recovery path then handles it like any other device loss.
+
+Fault-injection sites ``train.step`` (top of every step) and
+``grad.sync`` (between the per-rank gradient program and the sync/update
+program) make the whole arc deterministically chaos-testable; see
+``tests/test_train.py`` for the acceptance soak.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import layout as L
+from .. import telemetry as _tm
+from ..darray import distribute
+from ..parallel.collectives import shard_map_compat
+from ..resilience import elastic, faults, recovery
+from .optim import Optimizer, adam
+from .tasks import TrainTask
+
+__all__ = ["Trainer", "StragglerDetector", "DeadRankError", "fit_result"]
+
+
+class DeadRankError(RuntimeError):
+    """A straggler probe confirmed a rank's device is gone.  The message
+    carries the ``device lost`` fingerprint so ``recovery.classify``
+    reaches the ``device_loss`` verdict (restore + shrink + retry)."""
+
+    def __init__(self, ranks, budget_s: float, dur_s: float):
+        self.ranks = sorted(int(r) for r in ranks)
+        super().__init__(
+            f"straggler probe confirmed rank(s) {self.ranks} device lost "
+            f"(step took {dur_s:.3f}s against a {budget_s:.3f}s rolling "
+            f"p99 budget)")
+
+
+class StragglerDetector:
+    """Rolling p99-derived per-step wall-clock budget.
+
+    ``observe(dur)`` returns True when ``dur`` exceeded the budget in
+    force *before* this step (so one slow step cannot raise its own
+    bar), then folds the duration into the window.  No budget exists
+    until ``warmup`` steps have completed — the first steps pay jit
+    compilation and must not trip the detector."""
+
+    def __init__(self, factor: float = 3.0, min_budget_s: float = 0.25,
+                 warmup: int = 4, window: int = 64):
+        self.factor = float(factor)
+        self.min_budget_s = float(min_budget_s)
+        self.warmup = int(warmup)
+        self._durs: collections.deque = collections.deque(maxlen=window)
+
+    def budget(self) -> float | None:
+        """The current budget in seconds, or None during warmup."""
+        if len(self._durs) < self.warmup:
+            return None
+        s = sorted(self._durs)
+        p99 = s[min(len(s) - 1, math.ceil(0.99 * len(s)) - 1)]
+        return max(self.min_budget_s, self.factor * p99)
+
+    def observe(self, dur_s: float) -> bool:
+        b = self.budget()
+        exceeded = b is not None and dur_s > b
+        self._durs.append(float(dur_s))
+        return exceeded
+
+
+class Trainer:
+    """Data-parallel trainer over sharded DArrays (module docstring).
+
+    ``ckpt_dir=None`` trains without durable state (recovery retries
+    from live state); with a directory, a ``CheckpointManager`` publishes
+    integrity-verified steps every ``save_every`` steps and recovery
+    restores through it.  ``async_save`` defaults to False because the
+    chaos acceptance needs the published-step set at fault time to be a
+    pure function of the step index — flip it on when replay determinism
+    is not required.
+
+    ``ranks`` pins the device set (intersected with the elastic live
+    set each attempt); default is whatever ``elastic.manager()`` reports
+    live.
+    """
+
+    def __init__(self, task: TrainTask, optimizer: Optimizer | None = None,
+                 ckpt_dir=None, save_every: int = 0,
+                 step_deadline_s: float | None = None,
+                 policy: recovery.RetryPolicy | None = None,
+                 straggler: StragglerDetector | None = None,
+                 ranks: Sequence[int] | None = None,
+                 seed: int = 0, async_save: bool = False,
+                 max_to_keep: int | None = None):
+        self.task = task
+        self.opt = optimizer or adam()
+        self.save_every = int(save_every)
+        self.step_deadline_s = step_deadline_s
+        self.straggler = straggler or StragglerDetector()
+        self._policy = policy
+        self._pin_ranks = [int(r) for r in ranks] if ranks else None
+        self.seed = int(seed)
+        self._mgr = None
+        if ckpt_dir is not None:
+            from ..utils.checkpoint import CheckpointManager
+            self._mgr = CheckpointManager(ckpt_dir, async_save=async_save,
+                                          max_to_keep=max_to_keep)
+        self._step = 0
+        self._losses: dict[int, float] = {}
+        self._state: dict | None = None       # name -> DArray, + "spec"
+        self._spec = None                     # (treedef, shapes, size P)
+        self._batch = None                    # (step, [DArrays], w DArray)
+        self._progs: dict = {}
+        self._dispatch: dict = {}             # program key -> "rdma"|"xla"
+        self._closed = False
+
+    # -- flat parameter vector ---------------------------------------------
+
+    def _flatten_init(self):
+        params = self.task.init_params(jax.random.PRNGKey(self.seed))
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        shapes = [tuple(int(s) for s in np.shape(lf)) for lf in leaves]
+        flat = np.concatenate(
+            [np.asarray(lf, dtype=np.float32).ravel() for lf in leaves]) \
+            if leaves else np.zeros(0, np.float32)
+        self._spec = (treedef, shapes, int(flat.size))
+        return flat
+
+    def _unflatten(self, flat):
+        """Rebuild the params pytree from a flat (traced) vector —
+        static offsets, so this is free at run time."""
+        treedef, shapes, _ = self._spec
+        leaves, off = [], 0
+        for shp in shapes:
+            n = int(np.prod(shp)) if shp else 1
+            leaves.append(jnp.reshape(flat[off:off + n], shp))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- device set / state ------------------------------------------------
+
+    def _ranks_now(self) -> list[int]:
+        live = elastic.manager().live_ranks()
+        if self._pin_ranks is not None:
+            pinned = [r for r in self._pin_ranks if r in live]
+            if not pinned:
+                # the pin is a hard boundary: training must never
+                # silently migrate onto devices the caller excluded
+                raise RuntimeError(
+                    f"trainer: no pinned rank of {self._pin_ranks} is "
+                    f"live (live set: {live})")
+            return pinned
+        if not live:
+            raise RuntimeError("trainer: no live devices remain")
+        return live
+
+    def _state_names(self) -> list[str]:
+        return ["pflat"] + [f"m{i}" for i in range(self.opt.nslots)]
+
+    def _ensure_state(self):
+        if self._state is not None:
+            return
+        if self._mgr is not None and self._mgr.steps():
+            # resume: adopt the latest verified checkpoint (corrupt steps
+            # quarantine + fall back inside restore())
+            self._adopt(self._mgr.restore())
+            return
+        flat = self._flatten_init()
+        ranks = self._ranks_now()
+        p = len(ranks)
+        self._state = {"pflat": distribute(flat, procs=ranks, dist=[p])}
+        for i, slot in enumerate(self.opt.init_slots(flat.size)):
+            self._state[f"m{i}"] = distribute(slot, procs=ranks, dist=[p])  # dalint: disable=DAL006 — long-lived optimizer state, closed by _close_state()
+
+    def _adopt(self, tree: dict):
+        """Re-seat state from a restored checkpoint tree (recovery's
+        ``restore_fn`` and the resume path): close the current DArrays,
+        take the restored ones, rewind the step counter, truncate the
+        loss record, and discard now-stale later checkpoints so the
+        abandoned timeline can never be restored."""
+        if self._spec is None:
+            # spec is derived from the task, not the checkpoint; build it
+            # once (also reseeds nothing: init params are discarded)
+            self._flatten_init()
+        names = self._state_names()
+        missing = [k for k in names if k not in tree]
+        extra = [k for k in tree
+                 if k not in names and k != "step" and hasattr(tree[k],
+                                                               "close")]
+        if missing:
+            # a checkpoint written with a different optimizer: fail
+            # diagnosably — and close every restored DArray first, or
+            # the registered buffers leak for the process lifetime
+            for k, v in tree.items():
+                if hasattr(v, "close"):
+                    v.close()
+            raise ValueError(
+                f"checkpoint step {tree.get('step')} is missing optimizer "
+                f"state {missing} (this trainer expects {names}); it was "
+                f"written with a different optimizer configuration")
+        self._close_state()
+        self._state = {k: tree[k] for k in names}
+        # surplus restored state (a checkpoint written with MORE slots)
+        # is closed, not silently leaked
+        for k in extra:
+            tree[k].close()
+        self._step = int(tree["step"])
+        self._losses = {k: v for k, v in self._losses.items()
+                        if k < self._step}
+        if self._mgr is not None:
+            self._mgr.discard_from(self._step + 1)
+        _tm.count("train.reseats")
+        if _tm.enabled():
+            # cold path: a re-seat is one per recovery, not per step
+            _tm.event("train", "reseat", step=self._step)
+
+    def _close_state(self):
+        if self._state:
+            for d in self._state.values():
+                try:
+                    d.close()
+                except Exception:  # noqa: BLE001 — already-closed is fine
+                    pass
+        self._state = None
+
+    def _close_batch(self):
+        if self._batch is not None:
+            for d in self._batch[1]:
+                try:
+                    d.close()
+                except Exception:  # noqa: BLE001 — already-closed is fine
+                    pass
+            self._batch = None
+
+    # -- per-rank-count compiled programs ----------------------------------
+
+    def _programs(self, ranks: tuple, ppad: int, bshapes: tuple,
+                  bdtypes: tuple, b_real: int):
+        key = (ranks, ppad, bshapes, bdtypes, b_real, self.opt)
+        progs = self._progs.get(key)
+        if progs is not None:
+            return progs + (key, False)
+        p = len(ranks)
+        mesh = L.mesh_for(list(ranks), (p,))
+        ax = mesh.axis_names[0]
+        n_params = self._spec[2]
+        from ..ops.pallas_collectives import (ring_all_gather,
+                                              ring_reduce_scatter)
+
+        def grad_prog(pfl, w, *batch):
+            # fan the parameter shards out (ring AG on TPU, lax
+            # all_gather fallback elsewhere), per-rank grad on the local
+            # batch shard; the returned grad is this rank's FULL-length
+            # gradient, stacked so the sync program can ring it
+            full = ring_all_gather(pfl, ax, dim=0)
+
+            def lf(flat):
+                return self.task.loss_sum(
+                    self._unflatten(flat[:n_params]), batch, w)
+
+            loss, g = jax.value_and_grad(lf)(full)
+            return g[None], loss[None]
+
+        bspecs = tuple(P(ax, *([None] * (len(s) - 1))) for s in bshapes)
+        grad_fn = jax.jit(shard_map_compat(
+            grad_prog, mesh, in_specs=(P(ax), P(ax)) + bspecs,
+            out_specs=(P(ax, None), P(ax)), check=False))
+
+        def sync_prog(t, gstack, pfl, *slots):
+            g = gstack[0]
+            # each rank ends with its own slice of the globally-summed
+            # gradient (ring RS on TPU, psum_scatter fallback) — the
+            # ZeRO-1 sync — then updates only its parameter/moment slice
+            gs = ring_reduce_scatter(g, ax, dim=0) / jnp.float32(b_real)
+            return self.opt.update(t, pfl, gs, slots)
+
+        nst = self.opt.nslots
+        sync_fn = jax.jit(shard_map_compat(
+            sync_prog, mesh,
+            in_specs=(P(),) + (P(ax, None),) + (P(ax),) * (1 + nst),
+            out_specs=(P(ax),) * (1 + nst), check=False))
+        progs = (grad_fn, sync_fn)
+        self._progs[key] = progs
+        _tm.count("train.program_builds")
+        if _tm.enabled():
+            # cold path: one build per (rank count, shapes) combination
+            _tm.event("train", "program_build", ranks=p, ppad=ppad)
+        return progs + (key, True)
+
+    # -- batch pipeline ----------------------------------------------------
+
+    def _batch_for(self, step: int, ranks: list[int]):
+        """The step's batch as DArrays sharded over ``ranks`` (padded to
+        a rank-divisible global size; weight-0 rows are inert in
+        ``loss_sum``).  Returns ``(darrays, b_real)``.  Reused across
+        retry attempts of the same step — and because the DArrays are
+        registered, an ``elastic.shrink()`` between attempts re-lays
+        THEM onto survivors too."""
+        p = len(ranks)
+        cur = self._batch
+        if cur is not None and cur[0] == (step, tuple(ranks)):
+            return cur[1], cur[2]
+        self._close_batch()
+        leaves = self.task.batch(step)
+        b = int(np.shape(leaves[0])[0])
+        bpad = -(-b // p) * p
+        darrs = []
+        for x in leaves:
+            x = np.asarray(x)
+            if bpad != b:
+                pad = np.zeros((bpad - b,) + x.shape[1:], x.dtype)
+                x = np.concatenate([x, pad])
+            darrs.append(distribute(x, procs=ranks,  # dalint: disable=DAL006 — the step's batch shards, closed by _close_batch() on the next step/close
+                                    dist=[p] + [1] * (x.ndim - 1)))
+        w = np.zeros(bpad, np.float32)
+        w[:b] = 1.0
+        darrs.append(distribute(w, procs=ranks, dist=[p]))
+        self._batch = ((step, tuple(ranks)), darrs, b)
+        return darrs, b
+
+    # -- one recoverable step ----------------------------------------------
+
+    def _attempt_step(self):
+        n = self._step
+        ranks = self._ranks_now()
+        # state must live on the step's rank set before any program sees
+        # it: after a device-loss recovery, elastic.shrink() already
+        # re-laid the restored arrays onto the survivors, but a resume
+        # onto a pinned/changed rank set reaches here with the saved
+        # layout — route it through the same reshard planner
+        for d in self._state.values():
+            if sorted({int(x) for x in d.pids.flat}) != sorted(ranks):
+                elastic.relayout(d, ranks)
+        p = len(ranks)
+        n_params = self._spec[2]
+        ppad = -(-n_params // p) * p
+        batch_darrs, b_real = self._batch_for(n, ranks)
+        *bleaves, wq = [d.garray for d in batch_darrs]
+        b_pad = int(bleaves[0].shape[0])
+        bshapes = tuple(tuple(int(s) for s in x.shape) for x in bleaves)
+        bdtypes = tuple(str(x.dtype) for x in bleaves)
+        grad_fn, sync_fn, progkey, fresh_build = self._programs(
+            tuple(ranks), ppad, bshapes, bdtypes, b_real)
+
+        epoch = n // self.save_every if self.save_every else 0
+        with _tm.span("train.step", step=n, ranks=p):
+            if _tm.enabled():
+                from ..telemetry import perf as _perf
+                _tm.annotate(**_perf.train_step_cost(
+                    n_params=ppad, p=p,
+                    flops=float(self.task.step_flops(b_pad)),
+                    batch_bytes=sum(int(x.nbytes) for x in bleaves),
+                    nslots=self.opt.nslots))
+            t0 = time.monotonic()
+            # chaos site: the top of every step — the "host dies
+            # mid-epoch" injection point (a hang here counts against the
+            # straggler budget: the clock is already running)
+            faults.check("train.step", step=n, epoch=epoch)
+            pfl = jnp.pad(self._state["pflat"].garray,
+                          (0, ppad - n_params))
+            slots = [jnp.pad(self._state[f"m{i}"].garray,
+                             (0, ppad - n_params))
+                     for i in range(self.opt.nslots)]
+            # the dispatch label must reflect the path the ring kernels
+            # ACTUALLY took (per-kernel gates — VMEM, divisibility —
+            # can fall back to lax even with RDMA armed): on the
+            # program's first execution (its trace) the kernels bump
+            # the dispatch counter once per compilation, so the delta
+            # over the build step is the truth; later steps reuse it
+            rd0 = _dispatch_rdma_count() if fresh_build else 0
+            with _tm.span("train.grad", step=n, kind="compute"):
+                gstack, lsums = grad_fn(pfl, wq, *bleaves)
+                jax.block_until_ready(lsums)
+            # chaos site: between per-rank grads and the sync program —
+            # the gradient exchange is where a ring peer's death lands
+            faults.check("grad.sync", step=n)
+            with _tm.span("train.sync", step=n, kind="comm"):
+                outs = sync_fn(jnp.int32(n + 1), gstack, pfl, *slots)
+                jax.block_until_ready(outs)
+            if fresh_build:
+                self._dispatch[progkey] = \
+                    "rdma" if _dispatch_rdma_count() > rd0 else "xla"
+            _tm.annotate(dispatch=self._dispatch.get(progkey, "xla"))
+            dur = time.monotonic() - t0
+            # straggler gate BEFORE the update is applied: a confirmed
+            # dead rank must abort the step so the recovery retry
+            # (restore + shrink) recomputes it — never double-applies
+            # it.  A step that paid a fresh program build neither feeds
+            # nor is judged by the rolling window — compile time is not
+            # steady-state step time, and one such outlier would inflate
+            # the p99 budget for the whole window
+            if not fresh_build and self.straggler.observe(dur):
+                _tm.count("train.stragglers")
+                if _tm.enabled():
+                    # cold path: an exceeded budget is exceptional
+                    _tm.event("train", "straggler", step=n,
+                              dur=round(dur, 6))
+                probe = elastic.manager().probe()
+                dead = set(probe["down"]) & set(ranks)
+                if dead:
+                    raise DeadRankError(dead, self.straggler.budget()
+                                        or 0.0, dur)
+            loss = float(np.asarray(lsums, np.float32).sum()
+                         / np.float32(b_real))
+            new_p, *new_slots = outs
+            # write-back stays on device: __setitem__ at-sets the slice
+            # straight from the program's output arrays — a host
+            # round-trip of the full state here would dominate the step
+            self._state["pflat"][:] = new_p[:n_params]
+            for i, s in enumerate(new_slots):
+                self._state[f"m{i}"][:] = s[:n_params]
+        self._losses[n] = loss
+        self._step = n + 1
+        if self._mgr is not None and self.save_every and \
+                self._step % self.save_every == 0:
+            self._mgr.save(self._step, self._ckpt_tree())
+        return loss
+
+    def _ckpt_tree(self):
+        return {"step": self._step,
+                **{k: self._state[k] for k in self._state_names()}}
+
+    def _step_policy(self) -> recovery.RetryPolicy:
+        if self._policy is not None:
+            pol = self._policy
+        else:
+            pol = recovery.RetryPolicy()
+        if self.step_deadline_s is not None and \
+                pol.max_elapsed_s is None:
+            import dataclasses as _dc
+            pol = _dc.replace(pol, max_elapsed_s=self.step_deadline_s)
+        return pol
+
+    # -- public API --------------------------------------------------------
+
+    def fit(self, steps: int) -> dict:
+        """Train to ``steps`` total optimizer steps (resuming from the
+        current/restored step), each step under the recovery executor.
+
+        Returns ``{"losses", "start", "steps", "resumed_from"}``:
+        ``losses[i]`` is the final loss of step ``start + i`` —
+        ``start`` is 0 for a fresh run (a mid-run recovery rewound and
+        re-recorded the recomputed steps in place), and the restored
+        step for a trainer resumed from a checkpoint (it has no record
+        of the earlier steps)."""
+        if self._closed:
+            raise RuntimeError("trainer is closed")
+        self._ensure_state()
+        first = self._step
+        restore_fn = self._adopt if self._mgr is not None else None
+        try:
+            while self._step < int(steps):
+                recovery.run_with_recovery(
+                    self._attempt_step, policy=self._step_policy(),
+                    checkpoints=self._mgr, restore_fn=restore_fn)
+        finally:
+            self._close_batch()
+        if self._mgr is not None:
+            self._mgr.wait()
+        # a fresh trainer resumed from step S has no record before S; a
+        # mid-run rewind re-records the recomputed steps in place
+        start = min(self._losses) if self._losses else int(steps)
+        return {"losses": [self._losses[i]
+                           for i in range(start, int(steps))],
+                "start": start, "steps": self._step,
+                "resumed_from": first}
+
+    def step_once(self) -> float:
+        """One recovered step (the bench hook)."""
+        if self._closed:
+            raise RuntimeError("trainer is closed")
+        self._ensure_state()
+        restore_fn = self._adopt if self._mgr is not None else None
+        return recovery.run_with_recovery(
+            self._attempt_step, policy=self._step_policy(),
+            checkpoints=self._mgr, restore_fn=restore_fn)
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def losses(self) -> dict:
+        """Per-step final loss record (post-resume values win)."""
+        return dict(self._losses)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._close_batch()
+        self._close_state()
+        if self._mgr is not None:
+            self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _dispatch_rdma_count() -> int:
+    """Total RDMA-path dispatches of the trainer's two ring kernels —
+    ``_record_dispatch`` bumps these once per compilation, so a delta
+    across a program's first execution witnesses the path actually
+    taken (gates included), not merely the armed mode."""
+    return sum(_tm.counter_value("pallas_collectives.dispatch",
+                                 op=op, path="rdma")
+               for op in ("ring_all_gather", "ring_reduce_scatter"))
+
+
+def fit_result(losses: list, from_step: int = 0) -> list:
+    """The loss trajectory from a resume point (test/bench helper)."""
+    return list(losses[from_step:])

@@ -21,6 +21,16 @@ happens synchronously at ``save()``; serialization and disk IO run on a
 background thread so the train loop isn't stalled), atomic publication
 (write to a hidden temp dir, rename into place), and ``max_to_keep``
 rotation of completed steps.
+
+**Integrity:** every payload array's CRC32 is recorded in the step
+metadata at save time and re-verified on restore; a mismatch raises
+:class:`CheckpointIntegrityError`.  ``CheckpointManager.restore()``
+treats a corrupt step exactly like a partially-published one — it
+quarantines the bad step directory (renamed to ``.quarantine_step_*``,
+so it never counts as restorable again), journals a
+``restore_fallback``, and falls back to the previous verified step.
+The ``checkpoint.read`` fault site (action ``corrupt``) flips payload
+bytes deterministically so this whole path is chaos-testable.
 """
 
 from __future__ import annotations
@@ -29,6 +39,7 @@ import json
 import os
 import shutil
 import threading
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import Any
@@ -40,11 +51,55 @@ import jax
 from .. import telemetry as _tm
 from ..darray import DArray, DData, distribute
 
-__all__ = ["save", "load", "CheckpointManager"]
+__all__ = ["save", "load", "CheckpointManager", "CheckpointIntegrityError"]
 
 _META = "dartpu_meta.json"
 _ARRS = "arrays.npz"
 _ORBAX = "orbax_store"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint payload array failed its recorded CRC32 check —
+    bytes on disk (or the read path) are corrupt.  ``path`` is the
+    checkpoint directory, ``keys`` the failing payload keys."""
+
+    def __init__(self, path, keys: list):
+        self.path = str(path)
+        self.keys = list(keys)
+        super().__init__(
+            f"checkpoint {self.path} failed integrity verification: "
+            f"payload CRC32 mismatch on {self.keys}")
+
+
+def _crc_map(arrays: dict) -> dict:
+    """Per-payload CRC32 over the at-rest host bytes — the integrity
+    metadata stored next to the tree (one pass per array; checkpoint IO
+    dominates)."""
+    return {k: int(zlib.crc32(np.ascontiguousarray(v).tobytes()))
+            for k, v in arrays.items()}
+
+
+def _verify_integrity(path, meta_doc: dict, arrays: dict) -> None:
+    """Check every payload array against the CRC32s recorded at save
+    time.  Pre-integrity checkpoints (no ``integrity`` section) pass
+    unverified; a key recorded but missing from the payload counts as a
+    mismatch (a vanished shard is corruption, not absence)."""
+    integ = meta_doc.get("integrity") if isinstance(meta_doc, dict) else None
+    if not integ or not isinstance(integ.get("crc32"), dict):
+        return
+    bad = []
+    for key, want in integ["crc32"].items():
+        arr = arrays.get(key)
+        if arr is None or int(zlib.crc32(
+                np.ascontiguousarray(arr).tobytes())) != int(want):
+            bad.append(key)
+    if bad:
+        _tm.count("checkpoint.integrity_failures")
+        if _tm.enabled():
+            # cold path: a corrupt checkpoint is exceptional by definition
+            _tm.event("checkpoint", "integrity_failure", path=str(path),
+                      keys=",".join(sorted(bad)[:8]))
+        raise CheckpointIntegrityError(path, sorted(bad))
 
 
 def _encode(tree, arrays: dict, copy: bool = False):
@@ -168,6 +223,21 @@ def _decode(tree, arrays):
     return tree
 
 
+def _read_faults(path, store: str, arrays: dict) -> dict:
+    """The ``checkpoint.read`` injection site: a fired ``corrupt`` spec
+    flips payload bytes (seeded — :func:`faults.corrupt_arrays`); any
+    other action runs normally (``raise``/``device_loss``/``hang`` model
+    a failing storage read)."""
+    from ..resilience import faults as _fl
+    spec = _fl.decide("checkpoint.read", store=store, path=str(path))
+    if spec is None:
+        return arrays
+    if spec.action == "corrupt":
+        return _fl.corrupt_arrays(spec, arrays)
+    _fl.act(spec, {"store": store, "path": str(path)})
+    return arrays
+
+
 def save(path: str | os.PathLike, tree: Any, store: str = "npz") -> None:
     """Checkpoint a pytree (DArrays keep their layout metadata).
 
@@ -230,6 +300,12 @@ def load(path: str | os.PathLike) -> Any:
             else:
                 with np.load(path / _ARRS) as z:
                     arrays = {k: z[k] for k in z.files}
+        # chaos site: an armed plan can corrupt (or fail) the payload
+        # read — byte flips applied HERE, before verification, so the
+        # integrity check is what catches them, exactly like real disk
+        # rot would be caught
+        arrays = _read_faults(path, store, arrays)
+        _verify_integrity(path, meta_doc, arrays)
         with _tm.span("checkpoint.restore.decode", _journal=False):
             out = _decode(meta, arrays)
         if _tm.enabled():
@@ -269,7 +345,9 @@ def _write_store(path: Path, meta, arrays, store: str) -> None:
     _fl.check("checkpoint.write", store=store)
     # (orbax with no array leaves: nothing to store; load mirrors this)
     (path / _META).write_text(
-        json.dumps({"__dartpu_store__": store, "tree": meta}))
+        json.dumps({"__dartpu_store__": store, "tree": meta,
+                    "integrity": {"algo": "crc32",
+                                  "crc32": _crc_map(arrays)}}))
 
 
 class CheckpointManager:
@@ -440,9 +518,53 @@ class CheckpointManager:
                     _tm.event("checkpoint", "restore_fallback",
                               step=s, error=f"{type(e).__name__}: "
                                             f"{str(e)[:200]}")
+                if isinstance(e, CheckpointIntegrityError):
+                    # bytes on disk are provably bad: quarantine the step
+                    # so no later restore (or rotation census) ever
+                    # trusts it again — partial steps merely fall back,
+                    # corrupt ones are evicted
+                    self._quarantine(s)
         raise FileNotFoundError(
             f"no restorable checkpoint in {self.directory}: every "
             f"completed step failed to load") from last_exc
+
+    def _quarantine(self, step: int) -> None:
+        """Move a corrupt step directory to a hidden ``.quarantine_*``
+        name: it stops counting as a completed step (``steps()`` only
+        sees ``step_*``) but stays on disk for forensics."""
+        src = self._step_dir(step)
+        dst = self.directory / f".quarantine_{src.name}"
+        try:
+            if dst.exists():
+                shutil.rmtree(dst)
+            os.replace(src, dst)
+        except OSError:
+            # a quarantine that cannot rename still must not block the
+            # fallback restore; the step will fail integrity again next
+            # time and re-enter here
+            return
+        _tm.count("checkpoint.quarantines")
+        if _tm.enabled():
+            # cold path: quarantining a corrupt step is exceptional
+            _tm.event("checkpoint", "quarantine", step=step,
+                      path=str(dst))
+
+    def discard_from(self, step: int) -> list[int]:
+        """Delete every published step ``>= step`` (and drain pending
+        saves first).  The timeline-rewind primitive: a trainer that
+        restored step ``S`` and is about to recompute forward must
+        discard the now-stale later steps, or a future restore could
+        resurrect state from the abandoned timeline (e.g. a pre-shrink
+        device layout).  Returns the discarded step numbers."""
+        self.wait()
+        dropped = [s for s in self.steps() if s >= step]
+        for s in dropped:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        if dropped and _tm.enabled():
+            # cold path: a timeline rewind is a recovery-path event
+            _tm.event("checkpoint", "discard_from", step=step,
+                      dropped=len(dropped))
+        return dropped
 
     def wait(self) -> None:
         """Block until every pending async save has been published (and

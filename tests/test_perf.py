@@ -148,6 +148,48 @@ def test_timeline_overlap_groups_by_parent():
         pytest.approx(0.5)
 
 
+def test_train_step_overlap_pinned_timeline():
+    # hand-computed per-training-step grad-sync overlap: two train.step
+    # parents, each with a compute (train.grad) and a comm (train.sync)
+    # child.  Step 0: sync [4,8] vs grad [0,6] -> 2 of 4 hidden = 0.5.
+    # Step 1: fully serial -> 0.0.  A non-train parent with the same
+    # shape is ignored.
+    evs = [
+        _sp(1, "train.step", 0, 10.0,
+            labels={"step": 0, "ranks": 8, "dispatch": "xla"}),
+        _sp(2, "train.grad", 0, 6.0, parent=1,
+            labels={"kind": "compute", "step": 0}),
+        _sp(3, "train.sync", 4, 4.0, parent=1,
+            labels={"kind": "comm", "step": 0}, tid=2),
+        _sp(4, "train.step", 20, 10.0,
+            labels={"step": 1, "ranks": 8, "dispatch": "xla"}),
+        _sp(5, "train.grad", 20, 5.0, parent=4,
+            labels={"kind": "compute", "step": 1}),
+        _sp(6, "train.sync", 25, 3.0, parent=4,
+            labels={"kind": "comm", "step": 1}),
+        _sp(7, "other.step", 40, 10.0),
+        _sp(8, "sync", 40, 4.0, parent=7, labels={"kind": "comm"}),
+    ]
+    out = perf.train_step_overlap(evs)
+    assert [o["step"] for o in out] == [0, 1]
+    assert out[0]["overlap_frac"] == pytest.approx(0.5)
+    assert out[0]["comm_s"] == pytest.approx(4.0)
+    assert out[0]["ranks"] == 8 and out[0]["dispatch"] == "xla"
+    assert out[1]["overlap_frac"] == pytest.approx(0.0)
+    assert out[1]["unoverlapped_s"] == pytest.approx(3.0)
+    # analyze() surfaces the same numbers under "train_steps" and the
+    # doctor rendering prints the per-step section
+    a = perf.analyze(evs, peaks={"flops": 1.0, "hbm": 1.0, "ici": 1.0,
+                                 "platform": "t"})
+    assert [o["step"] for o in a["train_steps"]] == [0, 1]
+    import io
+    buf = io.StringIO()
+    perf.format_analysis(a, buf)
+    text = buf.getvalue()
+    assert "grad-sync overlap per training step" in text
+    assert "step 0" in text and "step 1" in text
+
+
 def test_overlap_stats_model_tier():
     peaks = {"flops": 100.0, "hbm": 1e12, "ici": 100.0, "platform": "t"}
     labels = {"flops": 100, "bytes_ici": 100, "ranks": 5}
