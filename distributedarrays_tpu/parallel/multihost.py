@@ -18,6 +18,7 @@ the reference running its full test suite on local `addprocs` workers.
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -30,12 +31,30 @@ from .. import telemetry as _tm
 
 __all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
            "host_local_slice", "gather_global", "heartbeat",
-           "down_peer_processes"]
+           "down_peer_processes", "quorum_assess"]
+
+
+def _init_timeout_kw(initialization_timeout_s: int | None) -> dict:
+    """Bounded coordinator startup: an explicit timeout wins, else
+    ``DA_TPU_MH_INIT_TIMEOUT_S``, else jax's default (300 s).  A cluster
+    whose coordinator never comes up must fail with a diagnosable
+    timeout, not hang the job (or a test harness) indefinitely."""
+    if initialization_timeout_s is None:
+        env = os.environ.get("DA_TPU_MH_INIT_TIMEOUT_S")
+        if env:
+            try:
+                initialization_timeout_s = int(float(env))
+            except ValueError:
+                initialization_timeout_s = None
+    if initialization_timeout_s is None:
+        return {}
+    return {"initialization_timeout": max(1, int(initialization_timeout_s))}
 
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> None:
+               process_id: int | None = None,
+               initialization_timeout_s: int | None = None) -> None:
     """Join the multi-host job (wraps ``jax.distributed.initialize``).
 
     With no arguments, attempts the standard auto-detecting initialization
@@ -43,16 +62,21 @@ def initialize(coordinator_address: str | None = None,
     degrades to a single-process no-op, so the same program runs on a
     laptop and a pod.  After joining, ``jax.devices()`` is the *global*
     device list and meshes built from it span hosts.
+
+    ``initialization_timeout_s`` (or ``DA_TPU_MH_INIT_TIMEOUT_S``) bounds
+    the coordinator handshake — past it the runtime raises instead of
+    waiting forever on a coordinator that never started.
     """
+    kw = _init_timeout_kw(initialization_timeout_s)
     if num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+            num_processes=num_processes, process_id=process_id, **kw)
         _tm.event("multihost", "initialize",
                   num_processes=num_processes, process_id=process_id)
         return
     try:
-        jax.distributed.initialize()
+        jax.distributed.initialize(**kw)
         _tm.event("multihost", "initialize", auto=True)
     except ValueError as e:
         # Degrade to single-process mode ONLY for the "nothing configured"
@@ -128,6 +152,61 @@ def down_peer_processes(stale_s: float = 30.0) -> set[int]:
         except ValueError:
             down.add(p)        # unparsable heartbeat = no heartbeat
     return down  # pragma: no cover
+
+
+def quorum_assess(stale_s: float = 30.0) -> dict:
+    """This controller's partition verdict over the failure-domain
+    topology: ``{"verdict": "healthy"|"quorum"|"minority", "side",
+    "lost", "reason"}``.
+
+    Two evidence sources, simulated first (so chaos runs are
+    deterministic): an armed ``partition`` fault's
+    ``faults.partition_state()``, else the real heartbeat census
+    (:func:`down_peer_processes` over the coordination-service KV).  The
+    decision itself is ``domains.majority_side``: the side holding a
+    strict majority of the expected ranks continues; a 50/50 tie breaks
+    toward the coordinator's side; and because a strict majority wins
+    regardless, a partition that swallows the coordinator still leaves
+    the majority running (coordinator-loss fallback).  Healthy (no
+    partition evidence) short-circuits — this is cheap enough for every
+    elastic probe epoch.
+    """
+    from ..resilience import domains as _dom
+    from ..resilience import faults as _fl
+    topo = _dom.topology()
+    expected = topo.ranks()
+    st = _fl.partition_state()
+    if st is not None:
+        q = _dom.majority_side(st["groups"], st["observer"],
+                               expected_total=len(expected))
+        out = {**q, "reason": "injected partition (fault plan)"}
+    else:
+        down_procs = down_peer_processes(stale_s=stale_s)
+        if not down_procs:
+            out = {"verdict": "healthy", "side": list(expected),
+                   "lost": [], "reason": "no partition evidence"}
+        else:  # pragma: no cover — needs a real multi-controller job
+            # heartbeat census: my side is every process still
+            # heartbeating (me included); the far side is the stale set.
+            # Rank granularity comes from the device→process map.
+            stale = set(down_procs)
+            mine, lost = [], []
+            for i, dev in enumerate(jax.devices()):
+                (lost if getattr(dev, "process_index", 0) in stale
+                 else mine).append(i)
+            q = _dom.majority_side([mine, lost], mine[0] if mine else 0,
+                                   expected_total=len(expected))
+            reason = "heartbeat census"
+            if 0 in stale:
+                reason += " (coordinator process lost)"
+            out = {**q, "reason": reason}
+    _tm.count("multihost.quorum_checks", verdict=out["verdict"])
+    if out["verdict"] != "healthy" and _tm.enabled():
+        # cold path: only journaled while partitioned
+        _tm.event("multihost", "quorum", verdict=out["verdict"],
+                  side=len(out["side"]), lost=len(out["lost"]),
+                  reason=out["reason"])
+    return out
 
 
 def process_info() -> dict:

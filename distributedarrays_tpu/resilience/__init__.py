@@ -14,22 +14,31 @@ in three layers:
 - :mod:`.recovery` — bounded retry + backoff + jitter around any
   workload, where the flight recorder's bundle classifies each failure
   (divergence → never retried; device loss → restore-from-checkpoint,
-  shrink, retry; timeout → one fresh-mesh retry).
+  shrink, retry; partition → quorum side shrinks to surviving domains
+  and retries, minority side exits typed; timeout → one fresh-mesh
+  retry).
+- :mod:`.domains` — the failure-domain topology (device → host →
+  domain), the cross-domain buddy-placement rule for peer-replicated
+  checkpoints, and the quorum rule partitions are judged by.
 
 See ``docs/resilience.md`` for the fault-plan format, the recovery
 decision table, and a worked chaos walkthrough.
 """
 
-from . import elastic, faults, recovery  # noqa: F401
+from . import domains, elastic, faults, recovery  # noqa: F401
+from .domains import DomainTopology, buddy_map, majority_side
 from .elastic import ElasticDeviceSet, manager, relayout
-from .faults import (FaultSpec, InjectedDeviceLoss, InjectedFault)
-from .recovery import RetryPolicy, classify, fresh_mesh, resilient, \
-    run_with_recovery
+from .faults import (FaultSpec, InjectedDeviceLoss, InjectedFault,
+                     InjectedPartition)
+from .recovery import MinorityPartitionExit, RetryPolicy, classify, \
+    fresh_mesh, resilient, run_with_recovery
 
 __all__ = [
-    "faults", "elastic", "recovery",
+    "faults", "elastic", "recovery", "domains",
     "FaultSpec", "InjectedFault", "InjectedDeviceLoss",
+    "InjectedPartition",
+    "DomainTopology", "buddy_map", "majority_side",
     "ElasticDeviceSet", "manager", "relayout",
-    "RetryPolicy", "classify", "fresh_mesh", "resilient",
-    "run_with_recovery",
+    "RetryPolicy", "MinorityPartitionExit", "classify", "fresh_mesh",
+    "resilient", "run_with_recovery",
 ]

@@ -1,0 +1,268 @@
+"""Failure-domain topology: device → host → domain, and buddy placement.
+
+The reference's unit of failure is the whole ``Distributed`` worker
+process — when a Julia worker dies, every chunk it owned dies with it.
+The TPU-native analog is the *host*: a partition or host loss takes down
+all of that host's devices at once, so resilience decisions (quorum,
+peer-replica placement, whole-domain shrink) must be made per failure
+domain, not per device.
+
+This module is the one place that topology lives:
+
+- :func:`topology` — the process-wide :class:`DomainTopology`.  By
+  default each JAX *process index* is one domain (``jax.devices()``
+  reports every device's owning controller), which collapses to a single
+  domain on a single-controller test mesh.  Deterministic chaos tests
+  override it with :func:`configure` (or ``DA_TPU_DOMAINS``) to carve
+  the 8-rank CPU mesh into synthetic hosts.
+- :func:`buddy_map` — for each live rank, a deterministic *buddy* rank
+  in a **different** failure domain: the peer-replica placement rule.
+  The placement invariant (asserted by the chaos suite): whenever at
+  least two domains have live ranks, no rank's buddy shares its domain
+  — a whole-domain loss can never take a payload chunk and its replica
+  together.  With a single live domain the map degrades to in-domain
+  buddies (flagged), because any placement then shares the failure unit.
+- :func:`majority_side` — the quorum rule shared by
+  ``parallel.multihost`` and ``resilience.recovery``: given the
+  partition's rank groups and the observer's rank, the side holding a
+  strict majority of the *expected* ranks continues; an exact tie breaks
+  toward the side holding the coordinator (the lowest expected rank), so
+  losing the coordinator itself never deadlocks a majority — the
+  coordinator-loss fallback.
+
+``DA_TPU_DOMAINS`` accepts either comma-separated group *sizes*
+(``"5,3"`` → ranks 0-4 | ranks 5-7) or a JSON list of rank groups
+(``"[[0,2],[1,3]]"``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .. import layout as L
+from .. import telemetry as _tm
+
+__all__ = ["DomainTopology", "topology", "configure", "reset",
+           "domain_of", "domains", "buddy_map", "is_cross_domain",
+           "majority_side"]
+
+_DOMAINS_ENV = "DA_TPU_DOMAINS"
+
+
+class DomainTopology:
+    """An immutable rank → failure-domain assignment.
+
+    ``groups`` is a list of rank lists; domain ids are the group's
+    position.  Every rank appears in exactly one group."""
+
+    def __init__(self, groups: list[list[int]]):
+        cleaned: list[list[int]] = []
+        seen: set[int] = set()
+        for g in groups:
+            ranks = sorted(int(r) for r in g)
+            if not ranks:
+                continue
+            dup = set(ranks) & seen
+            if dup or len(set(ranks)) != len(ranks):
+                raise ValueError(
+                    f"rank(s) {sorted(dup) or ranks} assigned to more than "
+                    f"one failure domain in {groups}")
+            seen |= set(ranks)
+            cleaned.append(ranks)
+        if not cleaned:
+            raise ValueError("domain topology needs at least one non-empty "
+                             "rank group")
+        self._groups = cleaned
+        self._dom_of = {r: i for i, g in enumerate(cleaned) for r in g}
+
+    def ranks(self) -> list[int]:
+        """Every rank the topology covers, ascending."""
+        return sorted(self._dom_of)
+
+    def domains(self) -> dict[int, list[int]]:
+        """domain id → its ranks (ascending)."""
+        return {i: list(g) for i, g in enumerate(self._groups)}
+
+    def domain_of(self, rank: int) -> int:
+        try:
+            return self._dom_of[int(rank)]
+        except KeyError:
+            raise KeyError(f"rank {rank} is not in the domain topology "
+                           f"(covered: {self.ranks()})") from None
+
+    def live_domains(self, live_ranks) -> dict[int, list[int]]:
+        """domain id → its currently-live ranks (empty domains omitted)."""
+        live = {int(r) for r in live_ranks}
+        out: dict[int, list[int]] = {}
+        for i, g in enumerate(self._groups):
+            alive = [r for r in g if r in live]
+            if alive:
+                out[i] = alive
+        return out
+
+    def __repr__(self):
+        return f"DomainTopology({self._groups})"
+
+
+_topo: DomainTopology | None = None
+_lock = threading.Lock()
+
+
+def _from_env(spec: str) -> DomainTopology:
+    s = spec.strip()
+    if s.startswith("["):
+        return DomainTopology(json.loads(s))
+    sizes = [int(x) for x in s.split(",") if x.strip()]
+    groups, start = [], 0
+    for n in sizes:
+        groups.append(list(range(start, start + n)))
+        start += n
+    return DomainTopology(groups)
+
+
+def _default() -> DomainTopology:
+    """One domain per JAX controller process — the real device→host map.
+    Single-controller (every device reports process index 0) collapses
+    to one domain, which is exactly right: there IS only one host."""
+    import jax
+    by_proc: dict[int, list[int]] = {}
+    try:
+        for i, dev in enumerate(jax.devices()):
+            by_proc.setdefault(int(getattr(dev, "process_index", 0)),
+                               []).append(i)
+    except Exception:
+        by_proc = {}
+    if not by_proc:
+        ranks = L.all_ranks()
+        by_proc = {0: ranks or [0]}
+    return DomainTopology([by_proc[p] for p in sorted(by_proc)])
+
+
+def topology() -> DomainTopology:
+    """The process-wide topology: an explicit :func:`configure` wins,
+    else ``DA_TPU_DOMAINS``, else the real per-process default."""
+    global _topo
+    if _topo is None:
+        with _lock:
+            if _topo is None:
+                env = os.environ.get(_DOMAINS_ENV)
+                _topo = _from_env(env) if env else _default()
+    return _topo
+
+
+def configure(groups) -> DomainTopology:
+    """Install an explicit topology (a list of rank groups, or an env-style
+    string) — the chaos-test override for carving a single-host mesh into
+    synthetic failure domains."""
+    global _topo
+    topo = _from_env(groups) if isinstance(groups, str) \
+        else DomainTopology(groups)
+    with _lock:
+        _topo = topo
+    if _tm.enabled():
+        # cold path: topology changes are per-session events
+        _tm.event("domains", "configure", domains=len(topo.domains()),
+                  ranks=len(topo.ranks()))
+    return topo
+
+
+def reset() -> None:
+    """Forget the configured topology (tests); the next :func:`topology`
+    re-derives it from the environment / real devices."""
+    global _topo
+    with _lock:
+        _topo = None
+
+
+def domain_of(rank: int) -> int:
+    return topology().domain_of(rank)
+
+
+def domains() -> dict[int, list[int]]:
+    return topology().domains()
+
+
+def buddy_map(live_ranks=None, topo: DomainTopology | None = None) -> dict:
+    """Deterministic replica placement: live rank → buddy rank.
+
+    Placement invariant: with ≥ 2 live domains every buddy lives in a
+    DIFFERENT domain than its owner (cross-domain), chosen round-robin
+    over the other domains' live ranks so replica load spreads evenly.
+    With exactly one live domain the map degrades to the next live rank
+    in ring order (same domain — the only placement that exists), and a
+    lone rank buddies with itself.  Pure function of
+    ``(live set, topology)``: the same survivors re-derive the same map
+    on every controller, so re-buddying after an uneven shrink needs no
+    coordination round.
+    """
+    topo = topo or topology()
+    if live_ranks is None:
+        from . import elastic as _el
+        live_ranks = _el.manager().live_ranks()
+    live = sorted({int(r) for r in live_ranks})
+    if not live:
+        return {}
+    dom_live = topo.live_domains(live)
+    out: dict[int, int] = {}
+    for dom, ranks in dom_live.items():
+        others = [r for d, rs in sorted(dom_live.items()) if d != dom
+                  for r in rs]
+        for i, r in enumerate(ranks):
+            if others:
+                out[r] = others[i % len(others)]
+            elif len(ranks) > 1:
+                # single live domain: in-domain ring buddy (degraded —
+                # the caller's telemetry should say so)
+                out[r] = ranks[(i + 1) % len(ranks)]
+            else:
+                out[r] = r
+    # ranks outside the topology (a test mesh larger than the configured
+    # groups) buddy within the uncovered set, ring order — never dropped
+    uncovered = [r for r in live if r not in topo._dom_of]
+    for i, r in enumerate(uncovered):
+        out[r] = uncovered[(i + 1) % len(uncovered)]
+    return out
+
+
+def is_cross_domain(bmap: dict, topo: DomainTopology | None = None) -> bool:
+    """True when every buddy pair in ``bmap`` spans two domains — the
+    placement invariant the chaos suite asserts."""
+    topo = topo or topology()
+    for r, b in bmap.items():
+        try:
+            if topo.domain_of(r) == topo.domain_of(b):
+                return False
+        except KeyError:
+            return False
+    return bool(bmap)
+
+
+def majority_side(groups, observer: int, expected_total: int | None = None,
+                  coordinator: int | None = None) -> dict:
+    """The quorum rule: which side of a partition continues.
+
+    ``groups`` are the partition's connected components (rank lists);
+    ``observer`` the rank whose side is being judged.  The observer's
+    side has quorum iff it holds a strict majority of ``expected_total``
+    ranks (default: every rank in ``groups``); an exact 50/50 tie breaks
+    toward the side holding the ``coordinator`` (default: the lowest
+    expected rank) — and because a *strict* majority wins regardless,
+    losing the coordinator to the minority side never strands the
+    majority (the coordinator-loss fallback).
+
+    Returns ``{"verdict": "quorum"|"minority", "side": [...],
+    "lost": [...]}``.
+    """
+    comps = [sorted(int(r) for r in g) for g in groups if g]
+    allr = sorted(r for g in comps for r in g)
+    total = int(expected_total) if expected_total is not None else len(allr)
+    coord = int(coordinator) if coordinator is not None \
+        else (min(allr) if allr else 0)
+    side = next((g for g in comps if int(observer) in g), [int(observer)])
+    lost = [r for r in allr if r not in side]
+    quorum = 2 * len(side) > total or \
+        (2 * len(side) == total and coord in side)
+    return {"verdict": "quorum" if quorum else "minority",
+            "side": side, "lost": lost}

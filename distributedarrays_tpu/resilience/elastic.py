@@ -139,6 +139,9 @@ class ElasticDeviceSet:
         # owner chose (growing everything would destroy deliberate
         # non-default distributions)
         self._shrunk: set = set()
+        # last quorum assessment from probe() — what serve/ reads to
+        # decide a typed drain instead of timing requests out
+        self._partition: dict | None = None
 
     # -- health ------------------------------------------------------------
 
@@ -245,13 +248,24 @@ class ElasticDeviceSet:
         (:meth:`_hw_probe`), advance the fault harness's revive clocks
         and merge its simulated-down set (the deterministic-test
         fallback) with the manual marks, and report
-        ``{"live": [...], "down": [...], "changed": bool}``."""
+        ``{"live": [...], "down": [...], "changed": bool,
+        "partition": {...}}``.  The partition entry is the multihost
+        quorum verdict (``quorum_assess``), cached for
+        :meth:`partition_verdict` — the health signal serve/ reads to
+        drain typed on the minority side."""
         hw = self._hw_probe()
         sim = faults.probe_tick()
+        try:
+            from ..parallel import multihost as _mh
+            part = _mh.quorum_assess()
+        except Exception:  # pragma: no cover — quorum must not kill probes
+            part = None
         with self._lock:
             changed = sim != self._sim_down or hw != self._hw_down
             self._sim_down = set(int(r) for r in sim)
             self._hw_down = set(int(r) for r in hw)
+            if part is not None:
+                self._partition = part
         self._update_gauge()
         live, down = self.live_ranks(), sorted(self.down_ranks())
         _tm.count("elastic.probes")
@@ -259,7 +273,20 @@ class ElasticDeviceSet:
             # cold path: only journaled on a health transition
             _tm.event("elastic", "probe", live=len(live),
                       down=down, hw=sorted(hw), sim=sorted(sim))
-        return {"live": live, "down": down, "changed": changed}
+        out = {"live": live, "down": down, "changed": changed}
+        if part is not None:
+            out["partition"] = dict(part)
+        return out
+
+    def partition_verdict(self) -> dict:
+        """The last probe epoch's quorum assessment (healthy until a
+        probe has run) — ``{"verdict": "healthy"|"quorum"|"minority",
+        "side", "lost", "reason"}``."""
+        with self._lock:
+            if self._partition is not None:
+                return dict(self._partition)
+        return {"verdict": "healthy", "side": self.live_ranks(),
+                "lost": [], "reason": "no probe epoch yet"}
 
     def _update_gauge(self) -> None:
         if _tm.enabled():
@@ -272,11 +299,22 @@ class ElasticDeviceSet:
 
     # -- re-layout ---------------------------------------------------------
 
-    def shrink(self) -> dict:
+    def shrink(self, domain: int | None = None) -> dict:
         """Re-lay-out every registered DArray touching a down rank onto
         the survivors.  Arrays whose data cannot be read (a REAL device
         loss) are left for the checkpoint-restore path and reported in
-        ``"failed"``."""
+        ``"failed"``.
+
+        ``domain``: first mark every rank of that failure domain down
+        (``resilience.domains`` topology) and then shrink — the
+        whole-host/whole-domain loss operation.  Survivor placement
+        therefore excludes the dying domain entirely: re-layout can never
+        seat a chunk (or, upstream, a peer replica) on a rank inside it.
+        """
+        if domain is not None:
+            from . import domains as _dm
+            for r in _dm.topology().domains()[int(domain)]:
+                self.mark_down(r, reason=f"domain:{int(domain)}")
         down = self.down_ranks()
         live = self.live_ranks()
         if not live:
@@ -302,12 +340,19 @@ class ElasticDeviceSet:
             _tm.memory.sample("elastic.shrink")
         return {"live": live, "moved": moved, "failed": failed}
 
-    def grow(self) -> dict:
+    def grow(self, domain: int | None = None) -> dict:
         """After revival: re-lay-out the arrays ``shrink()`` displaced
         back onto the (recovered) live set — and ONLY those.  Arrays the
         failure never touched keep the layout their owner chose.  A
         failed move is reported like shrink's, and the array stays
-        marked so a later grow epoch retries it."""
+        marked so a later grow epoch retries it.
+
+        ``domain``: first mark every rank of that failure domain back up
+        (the inverse of ``shrink(domain=...)``), then grow."""
+        if domain is not None:
+            from . import domains as _dm
+            for r in _dm.topology().domains()[int(domain)]:
+                self.mark_up(r)
         live = self.live_ranks()
         # the shrink mark clears only once NO device is down: a grow
         # epoch during a partial revival (or with the device still down)
@@ -349,6 +394,7 @@ class ElasticDeviceSet:
             self._hw_down.clear()
             self._shrunk.clear()
             self._expected = None      # re-snapshot on the next probe
+            self._partition = None
         self._update_gauge()
 
 

@@ -75,7 +75,13 @@ that goes quiet and is then found dead by a health probe), ``exit``
 (``os._exit`` in forked ranks: death without a report; degrades to
 ``raise`` in-process), ``corrupt`` (no exception at the site — the
 caller applies seeded byte-flips to its payload via
-:func:`corrupt_arrays`; the checkpoint read path is the consumer).
+:func:`corrupt_arrays`; the checkpoint read path is the consumer),
+``partition`` (sever the heartbeat/KV path between host ``groups``: the
+ranks on the far side of ``observer`` join the simulated-down set at
+once and :class:`InjectedPartition` raises at the site — the quorum rule
+reads :func:`partition_state`), ``slow_link`` (a degraded, not dead,
+link: sleep a seeded fraction of ``hang_s`` and proceed — the straggler
+budget is what notices).
 ``at`` is the 1-based matching-invocation index of the first firing,
 ``count`` how many consecutive matching invocations fire (``-1`` =
 forever), ``p`` an optional seeded per-invocation firing probability,
@@ -99,10 +105,10 @@ from typing import Any
 from .. import telemetry as _tm
 
 __all__ = [
-    "InjectedFault", "InjectedDeviceLoss", "FaultSpec",
+    "InjectedFault", "InjectedDeviceLoss", "InjectedPartition", "FaultSpec",
     "configure", "clear", "active", "check", "decide", "act",
     "history", "simulated_down", "probe_tick", "revive", "jitter",
-    "corrupt_arrays",
+    "corrupt_arrays", "partition_state", "heal_partition",
 ]
 
 _SEED_ENV = "DA_TPU_FAULT_SEED"
@@ -134,6 +140,26 @@ class InjectedDeviceLoss(InjectedFault):
             else labels.get("rank")
 
 
+class InjectedPartition(InjectedFault):
+    """A network partition severing the heartbeat/KV path between host
+    groups: every rank on the far side of the observer joins the
+    simulated-down set at once, and ``recovery`` classifies the failure
+    ``partition`` — the quorum rule (``domains.majority_side``) then
+    decides whether this side continues or exits.  ``groups`` are the
+    partition's rank components, ``observer`` the rank whose side this
+    controller observes from, ``lost`` the far-side ranks."""
+
+    def __init__(self, spec: "FaultSpec", labels: dict):
+        self.groups = [list(int(r) for r in g) for g in (spec.groups or [])]
+        self.observer = int(spec.observer if spec.observer is not None
+                            else 0)
+        side = next((g for g in self.groups if self.observer in g),
+                    [self.observer])
+        self.lost = sorted(r for g in self.groups for r in g
+                           if r not in side)
+        super().__init__(spec, labels)
+
+
 @dataclasses.dataclass
 class FaultSpec:
     """One entry of a fault plan (see module docstring for semantics)."""
@@ -148,6 +174,8 @@ class FaultSpec:
     hang_s: float = 0.2
     p: float | None = None               # seeded firing probability
     flips: int = 8                       # bytes inverted by "corrupt"
+    groups: list | None = None           # "partition": the rank components
+    observer: int | None = None          # "partition": this side's rank
     index: int = 0                       # position in the plan (set on load)
 
     @classmethod
@@ -160,8 +188,11 @@ class FaultSpec:
         spec = cls(**{k: v for k, v in d.items() if k != "index"})
         spec.index = index
         if spec.action not in ("raise", "device_loss", "hang", "exit",
-                               "corrupt"):
+                               "corrupt", "partition", "slow_link"):
             raise ValueError(f"unknown fault action {spec.action!r}")
+        if spec.action == "partition" and not spec.groups:
+            raise ValueError("a 'partition' spec needs 'groups' (the rank "
+                             "components the partition splits into)")
         if spec.at < 1:
             raise ValueError(f"fault spec 'at' is 1-based, got {spec.at}")
         return spec
@@ -187,6 +218,9 @@ class _Injector:
         # device -> remaining elastic probes until auto-revive (None =
         # down until an explicit mark_up)
         self.down: dict[int, int | None] = {}
+        # the active simulated partition, or None: {"groups", "observer",
+        # "side", "lost", "spec"} — cleared once every lost rank revives
+        self.partition: dict | None = None
 
     def decide(self, site: str, labels: dict) -> FaultSpec | None:
         with self.lock:
@@ -222,8 +256,33 @@ class _Injector:
                     # next elastic probe sees the device down — the
                     # straggler-detection scenario
                     self.down[int(spec.device)] = spec.revive_after
+                elif spec.action == "partition":
+                    # sever the heartbeat/KV path between the host
+                    # groups: every rank on the far side of the observer
+                    # joins the simulated-down set at once, and the
+                    # partition state stays queryable (partition_state —
+                    # the quorum rule's input) until they all revive
+                    obs = int(spec.observer if spec.observer is not None
+                              else 0)
+                    groups = [[int(r) for r in g]
+                              for g in (spec.groups or [])]
+                    side = next((g for g in groups if obs in g), [obs])
+                    lost = sorted(r for g in groups for r in g
+                                  if r not in side)
+                    for r in lost:
+                        self.down[r] = spec.revive_after
+                    self.partition = {"groups": groups, "observer": obs,
+                                      "side": sorted(side), "lost": lost,
+                                      "spec": spec.index}
                 return spec
         return None
+
+    def partition_gone(self) -> None:
+        """Clear the partition record once every far-side rank healed
+        (call with ``self.lock`` held)."""
+        if self.partition is not None and \
+                not any(r in self.down for r in self.partition["lost"]):
+            self.partition = None
 
 
 _injector: _Injector | None = None
@@ -324,8 +383,19 @@ def act(spec: FaultSpec | None, labels: dict | None = None) -> None:
     if spec.action == "hang":
         time.sleep(spec.hang_s)
         return
+    if spec.action == "slow_link":
+        # a degraded (not dead) link: sleep a seeded fraction of hang_s
+        # at the collective/reshard site, then proceed normally — the
+        # straggler detector's budget, not an exception, is what notices.
+        # The delay is a pure function of (seed, spec, firing number), so
+        # a chaos replay stalls the exact same invocations for the exact
+        # same time.
+        time.sleep(slow_link_delay(spec))
+        return
     if spec.action == "device_loss":
         raise InjectedDeviceLoss(spec, labels)
+    if spec.action == "partition":
+        raise InjectedPartition(spec, labels)
     if spec.action == "corrupt":
         # payload-targeted action: the site applies the byte flips itself
         # via corrupt_arrays(); at a site that never consumes it the
@@ -385,6 +455,52 @@ def corrupt_arrays(spec: FaultSpec, arrays: dict) -> dict:
     return out
 
 
+def slow_link_delay(spec: FaultSpec) -> float:
+    """The seeded sleep one ``slow_link`` firing injects: a draw in
+    ``[0.5, 1.0) * hang_s`` keyed by ``(seed, spec, firing number)`` —
+    deterministic under replay, never zero (a fired slowdown that slept
+    0 s would be unobservable by the straggler budget it exists to
+    exercise)."""
+    inj = _current()
+    if inj is None:
+        return float(spec.hang_s)
+    with inj.lock:
+        n = inj.counts.get(spec.index, 0)      # the firing this applies to
+    u = _random.Random(_mix(inj.seed, spec.index + 50_021, n)).random()
+    return float(spec.hang_s) * (0.5 + 0.5 * u)
+
+
+def partition_state() -> dict | None:
+    """The active simulated partition (``{"groups", "observer", "side",
+    "lost", "spec"}``), or None — the quorum rule's deterministic input
+    (``parallel.multihost.quorum_assess`` consults it before the real
+    heartbeat census).  Clears automatically once every far-side rank
+    has revived."""
+    inj = _current()
+    if inj is None:
+        return None
+    with inj.lock:
+        inj.partition_gone()
+        return dict(inj.partition) if inj.partition is not None else None
+
+
+def heal_partition() -> None:
+    """Explicitly heal the simulated partition: revive every far-side
+    rank and clear the partition record (the operator escape hatch for
+    specs with no ``revive_after`` countdown)."""
+    inj = _current()
+    if inj is None:
+        return
+    with inj.lock:
+        if inj.partition is None:
+            return
+        for r in inj.partition["lost"]:
+            if inj.down.pop(r, "absent") != "absent":
+                _tm.count("faults.revives")
+        inj.partition = None
+    _tm.count("faults.partition_heals")
+
+
 def history() -> list[dict]:
     """Fired-decision history (site, spec index, invocation, action,
     labels) — the determinism witness: same plan + seed ⇒ same history."""
@@ -416,6 +532,7 @@ def revive(rank: int) -> None:
     with inj.lock:
         if inj.down.pop(int(rank), "absent") != "absent":
             _tm.count("faults.revives")
+        inj.partition_gone()
 
 
 def probe_tick() -> set[int]:
@@ -436,6 +553,7 @@ def probe_tick() -> set[int]:
                 _tm.count("faults.revives")
             else:
                 inj.down[dev] = left
+        inj.partition_gone()
         return set(inj.down)
 
 

@@ -17,6 +17,14 @@ classifies the failure first, and the verdict decides the path —
 ``timeout``        a stuck collective/receive: retried ONCE with a fresh
                    mesh (the compiled-program and mesh caches dropped, so
                    the retry rebuilds its collectives from scratch).
+``partition``      the mesh split into disconnected host groups: the
+                   quorum rule (``domains.majority_side`` over the
+                   multihost heartbeat census or the injected fault's
+                   groups) decides — the MAJORITY side shrinks to its
+                   surviving domains, restores (peer replicas first), and
+                   retries like device loss; the MINORITY side exits
+                   cleanly with a typed :class:`MinorityPartitionExit`
+                   and exactly ONE flight bundle, never retried.
 ``transient``      everything else (a killed rank, a flaky allocation):
                    plain bounded retry with exponential backoff + jitter.
 =================  =========================================================
@@ -42,10 +50,11 @@ import time
 from .. import telemetry as _tm
 from . import elastic, faults
 
-__all__ = ["RetryPolicy", "classify", "run_with_recovery", "resilient",
-           "fresh_mesh"]
+__all__ = ["RetryPolicy", "MinorityPartitionExit", "classify",
+           "run_with_recovery", "resilient", "fresh_mesh"]
 
-VERDICTS = ("divergence", "device_loss", "timeout", "transient")
+VERDICTS = ("divergence", "device_loss", "partition", "timeout",
+            "transient")
 
 # message fingerprints for failures that arrive as text (the process
 # backend ships child tracebacks as strings; real runtimes stringify
@@ -53,7 +62,23 @@ VERDICTS = ("divergence", "device_loss", "timeout", "transient")
 _DEVICE_LOSS_MARKS = ("InjectedDeviceLoss", "DATA_LOSS", "device lost",
                       "unreachable", "failed to connect")
 _DIVERGENCE_MARKS = ("CollectiveDivergenceError",)
+_PARTITION_MARKS = ("InjectedPartition", "network partition")
 _TIMEOUT_MARKS = ("timed out", "TimeoutError")
+
+
+class MinorityPartitionExit(RuntimeError):
+    """The clean minority-side exit: this controller's partition side
+    lost quorum, so the retry loop stops — re-running cannot win a
+    quorum back, and a minority that keeps computing risks split-brain
+    state.  Raised once per partition (exactly one flight bundle),
+    never retried; a process runner should treat it as an orderly
+    shutdown, not a crash."""
+
+    def __init__(self, message: str, *, side: list[int] | None = None,
+                 lost: list[int] | None = None):
+        super().__init__(message)
+        self.side = list(side or [])
+        self.lost = list(lost or [])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,12 +131,19 @@ def classify(exc: BaseException) -> str:
     for e in _chain(exc):
         if isinstance(e, CollectiveDivergenceError):
             return "divergence"
+        if isinstance(e, faults.InjectedPartition):
+            # before the device-loss check: InjectedPartition IS an
+            # InjectedFault that downs ranks, but the verdict must route
+            # through the quorum rule, not the per-device path
+            return "partition"
         if isinstance(e, faults.InjectedDeviceLoss):
             return "device_loss"
         texts.append(f"{type(e).__name__}: {e}")
     blob = " | ".join(texts)
     if any(m in blob for m in _DIVERGENCE_MARKS):
         return "divergence"
+    if any(m in blob for m in _PARTITION_MARKS):
+        return "partition"
     if any(m in blob for m in _DEVICE_LOSS_MARKS):
         return "device_loss"
     for e in _chain(exc):
@@ -120,6 +152,23 @@ def classify(exc: BaseException) -> str:
     if any(m in blob for m in _TIMEOUT_MARKS):
         return "timeout"
     return "transient"
+
+
+def _partition_quorum(exc: BaseException) -> dict:
+    """Adjudicate a partition failure: which side is THIS controller on?
+    An :class:`faults.InjectedPartition` in the cause chain carries the
+    split's groups and observer directly (the deterministic-chaos path);
+    otherwise the live multihost heartbeat census decides
+    (``multihost.quorum_assess``)."""
+    from . import domains as _dom
+    for e in _chain(exc):
+        if isinstance(e, faults.InjectedPartition):
+            expected = _dom.topology().ranks()
+            q = _dom.majority_side(e.groups, e.observer,
+                                   expected_total=len(expected))
+            return {**q, "reason": "injected partition (fault plan)"}
+    from ..parallel import multihost as _mh
+    return _mh.quorum_assess()
 
 
 # the flight recorder stamps every postmortem bundle with this verdict
@@ -204,6 +253,11 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             # more attempts (and bundles) re-running it
             raise
         except Exception as e:  # noqa: BLE001 — verdict decides below
+            if any(isinstance(x, MinorityPartitionExit) for x in _chain(e)):
+                # already adjudicated (a nested recovery loop raised the
+                # typed exit): pass through with no second bundle, no
+                # retry — "exactly one flight bundle" is the contract
+                raise
             # one postmortem per failure: spmd/djit already bundled the
             # root cause on their crash path; this dedups against it and
             # only bundles failures that never passed through them.
@@ -215,6 +269,26 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             fresh = _tm.flight.crash_bundle_count() > n0
             verdict = _bundle_verdict(e, _tm.flight.last_bundle(), fresh)
             _tm.count("recovery.failures", verdict=verdict)
+            if verdict == "partition":
+                # the quorum rule decides BEFORE any retry math: a
+                # minority side can never win quorum back by re-running,
+                # and continuing risks split-brain state — typed clean
+                # exit, never retried.  The majority side falls through
+                # to the device-loss discipline (probe → restore →
+                # shrink to surviving domains → retry).
+                q = _partition_quorum(e)
+                if q["verdict"] == "minority":
+                    _tm.count("recovery.giveups", verdict=verdict)
+                    _tm.count("recovery.minority_exits")
+                    if _tm.enabled():
+                        # cold path: one event per partition exit
+                        _tm.event("recovery", "minority_exit",
+                                  side=q["side"], lost=q["lost"],
+                                  reason=q.get("reason", ""))
+                    raise MinorityPartitionExit(
+                        f"partition minority side {q['side']} lost quorum "
+                        f"(lost contact with {q['lost']}): exiting cleanly",
+                        side=q["side"], lost=q["lost"]) from e
             retries_used = attempt - 1
             interrupted = stop_event is not None and stop_event.is_set()
             remaining = _remaining()
@@ -241,7 +315,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             if verdict == "timeout":
                 timeout_retries += 1
                 fresh_mesh()
-            if verdict == "device_loss":
+            if verdict in ("device_loss", "partition"):
                 devs.probe()
             if checkpoints is not None and restore_fn is not None:
                 try:
@@ -266,9 +340,10 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                 if state is not None:
                     restore_fn(state)
                     _tm.count("recovery.restores")
-            if verdict == "device_loss":
+            if verdict in ("device_loss", "partition"):
                 # shrink AFTER the restore so freshly restored arrays
-                # land on survivors too
+                # land on survivors too; for a partition this is the
+                # quorum side shrinking to its surviving domains
                 devs.shrink()
             # restore/shrink themselves take wall time: re-check the
             # budget before launching a fresh attempt, or a slow

@@ -200,6 +200,21 @@ class Server:
             # with the put (so concurrent submitters cannot overshoot
             # max_queue)
             with self._lock:
+                # partition health gate: a minority-side server must
+                # drain typed, not time requests out — the quorum verdict
+                # rides the elastic manager's probe epochs
+                part = getattr(self._devices, "partition_verdict",
+                               lambda: None)()
+                if part is not None and part.get("verdict") == "minority" \
+                        and not self._draining:
+                    self._draining = True
+                    self._drain_wake.set()
+                    _tm.count("serve.partition_drains")
+                    if _tm.enabled():
+                        # cold path: one event per partition drain
+                        _tm.event("serve", "partition_drain",
+                                  side=part.get("side", []),
+                                  lost=part.get("lost", []))
                 if self._draining or self._closed:
                     _tm.count("serve.shed", reason="draining",
                               tenant=tenant)
@@ -320,6 +335,27 @@ class Server:
                     checkpoints=self._checkpoints,
                     restore_fn=self._restore_fn, devices=self._devices,
                     stop_event=self._drain_wake)
+        except recovery.MinorityPartitionExit as e:
+            # this controller lost quorum mid-dispatch: initiate the
+            # typed drain (admission closes, workers flush and stop) and
+            # fail the batch Draining — the client-visible story is
+            # "server going away", not a generic dispatch failure
+            dt = time.monotonic() - t0
+            self._admission.latency.record(dt)
+            with self._lock:
+                self._draining = True
+            self._drain_wake.set()
+            _tm.count("serve.partition_drains")
+            _tm.count("serve.failed", n=len(live), endpoint=ep.name)
+            if _tm.enabled():
+                # cold path: one event per partition drain
+                _tm.event("serve", "partition_drain", side=e.side,
+                          lost=e.lost, endpoint=ep.name)
+            err = Draining("server lost partition quorum; draining")
+            err.__cause__ = e
+            for r in live:
+                r.fail(err)
+            return
         except Exception as e:  # noqa: BLE001 — typed and shipped to futures
             dt = time.monotonic() - t0
             self._admission.latency.record(dt)

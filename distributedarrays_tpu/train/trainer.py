@@ -129,7 +129,8 @@ class Trainer:
                  straggler: StragglerDetector | None = None,
                  ranks: Sequence[int] | None = None,
                  seed: int = 0, async_save: bool = False,
-                 max_to_keep: int | None = None):
+                 max_to_keep: int | None = None,
+                 peer_replicas: bool = False):
         self.task = task
         self.opt = optimizer or adam()
         self.save_every = int(save_every)
@@ -140,9 +141,15 @@ class Trainer:
         self.seed = int(seed)
         self._mgr = None
         if ckpt_dir is not None:
-            from ..utils.checkpoint import CheckpointManager
-            self._mgr = CheckpointManager(ckpt_dir, async_save=async_save,
-                                          max_to_keep=max_to_keep)
+            from ..utils.checkpoint import CheckpointManager, \
+                PeerReplicaStore
+            # peer_replicas: every published step is also replicated into
+            # buddy-rank memory (cross-failure-domain placement), and a
+            # device-loss/partition restore pulls from there first — zero
+            # disk reads when a whole host's shards die
+            self._mgr = CheckpointManager(
+                ckpt_dir, async_save=async_save, max_to_keep=max_to_keep,
+                replicas=PeerReplicaStore() if peer_replicas else None)
         self._step = 0
         self._losses: dict[int, float] = {}
         self._state: dict | None = None       # name -> DArray, + "spec"

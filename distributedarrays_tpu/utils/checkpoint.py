@@ -31,6 +31,15 @@ so it never counts as restorable again), journals a
 ``restore_fallback``, and falls back to the previous verified step.
 The ``checkpoint.read`` fault site (action ``corrupt``) flips payload
 bytes deterministically so this whole path is chaos-testable.
+
+**Peer replicas:** pass ``replicas=PeerReplicaStore()`` to the manager
+and every published step is ALSO replicated chunk-by-chunk into buddy
+ranks' memory — each chunk's buddy in a *different* failure domain
+(``resilience.domains.buddy_map``), CRC-stamped.  ``restore()`` then
+tries the peer replica first and falls back to disk, so a
+device-loss/partition recovery runs at interconnect speed and a whole
+host's shards survive its loss with zero disk reads (witnessed by the
+``checkpoint.disk_reads`` vs ``checkpoint.restore_source`` counters).
 """
 
 from __future__ import annotations
@@ -51,7 +60,8 @@ import jax
 from .. import telemetry as _tm
 from ..darray import DArray, DData, distribute
 
-__all__ = ["save", "load", "CheckpointManager", "CheckpointIntegrityError"]
+__all__ = ["save", "load", "CheckpointManager", "CheckpointIntegrityError",
+           "PeerReplicaStore", "PeerReplicaUnavailable"]
 
 _META = "dartpu_meta.json"
 _ARRS = "arrays.npz"
@@ -279,6 +289,9 @@ def load(path: str | os.PathLike) -> Any:
     devices are available than at save time)."""
     path = Path(path)
     with _tm.span("checkpoint.restore"):
+        # the zero-disk-reads witness for peer-replica restores: every
+        # on-disk load counts here, a replica fetch never reaches this
+        _tm.count("checkpoint.disk_reads")
         # cold path: checkpoint I/O dominates the event cost
         _tm.event("checkpoint", "restore_start", path=str(path))  # dalint: disable=DAL003
         meta_doc = json.loads((path / _META).read_text())
@@ -350,6 +363,200 @@ def _write_store(path: Path, meta, arrays, store: str) -> None:
                                   "crc32": _crc_map(arrays)}}))
 
 
+class PeerReplicaUnavailable(RuntimeError):
+    """No live rank holds a needed replica chunk — both its owner and
+    its buddy holder are down (e.g. a partition took two domains at
+    once).  The restore path falls back to disk past this."""
+
+    def __init__(self, step: int, key: str, chunk: int,
+                 owner: int, holder: int):
+        self.step, self.key, self.chunk = int(step), str(key), int(chunk)
+        super().__init__(
+            f"peer replica for step {step} chunk {key}[{chunk}] is gone: "
+            f"owner rank {owner} and holder rank {holder} are both down")
+
+
+def _darray_entries(meta) -> dict:
+    """Every encoded-DArray placeholder in a checkpoint tree, by payload
+    key — the chunk layout (procs/dist/cuts) peer replication shards by."""
+    out: dict = {}
+
+    def walk(t):
+        if isinstance(t, dict):
+            if t.get("__dartpu__") == "DArray":
+                out[t["key"]] = t
+                return
+            for v in t.values():
+                walk(v)
+        elif isinstance(t, list):
+            for v in t:
+                walk(v)
+    walk(meta)
+    return out
+
+
+def _chunk_slices(entry: dict) -> list:
+    """Per-block ``(owner_rank, index_slices)`` for one encoded DArray,
+    in the block grid's row-major order — the unit peer replication
+    copies, exactly the bytes that rank's device held."""
+    grid = tuple(int(x) for x in entry["dist"])
+    procs = [int(p) for p in entry["procs"]]
+    cuts = entry["cuts"]
+    out = []
+    for j, owner in enumerate(procs):
+        coords = np.unravel_index(j, grid) if grid else ()
+        sl = tuple(slice(int(cuts[d][c]), int(cuts[d][c + 1]))
+                   for d, c in enumerate(coords))
+        out.append((owner, sl))
+    return out
+
+
+class PeerReplicaStore:
+    """In-memory peer replicas of checkpoint payloads, placed by failure
+    domain.
+
+    The single-controller model of per-host RAM replication: at publish
+    time every payload chunk is copied into its owner rank's *buddy*
+    rank (``resilience.domains.buddy_map`` — a different failure domain
+    whenever two domains are live), CRC-stamped per chunk.  A later
+    :meth:`fetch` reassembles the step from chunks whose owner is still
+    live ("local") or whose holder is ("peer" — the over-the-wire pull),
+    so a whole domain's loss costs zero disk reads; only when BOTH sides
+    of a chunk are down does the restore fall back to disk.  On a real
+    multi-controller deployment the same placement map drives RDMA copies
+    between hosts; the store's accounting (owner/holder/CRC per chunk) is
+    identical.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # step -> {"meta": tree, "keys": {key: (shape, dtype)},
+        #          "chunks": {(key, j): {owner, holder, data, crc, slices}}}
+        self._steps: dict[int, dict] = {}
+
+    # -- placement ---------------------------------------------------------
+
+    def put(self, step: int, meta, arrays: dict,
+            live_ranks=None) -> dict:
+        """Replicate one encoded checkpoint into buddy memory.  Returns
+        ``{"chunks": n, "bytes": n, "cross_domain": bool}``."""
+        from ..resilience import domains as _dm
+        if live_ranks is None:
+            from ..resilience import elastic as _el
+            live_ranks = _el.manager().live_ranks()
+        live = sorted({int(r) for r in live_ranks})
+        bmap = _dm.buddy_map(live)
+        dents = _darray_entries(meta)
+        chunks: dict = {}
+        keys: dict = {}
+        total = 0
+        for key, arr in arrays.items():
+            host = np.ascontiguousarray(arr)
+            keys[key] = (tuple(host.shape), host.dtype.str)
+            if key in dents:
+                parts = _chunk_slices(dents[key])
+            else:
+                # plain (replicated) leaf: one chunk, conceptually owned
+                # by the first live rank's host
+                parts = [(live[0] if live else 0,
+                          tuple(slice(0, n) for n in host.shape))]
+            for j, (owner, sl) in enumerate(parts):
+                data = host[sl].tobytes()
+                total += len(data)
+                chunks[(key, j)] = {
+                    "owner": int(owner),
+                    "holder": int(bmap.get(int(owner), int(owner))),
+                    "data": data,
+                    "crc": int(zlib.crc32(data)),
+                    "slices": [(s.start, s.stop) for s in sl],
+                }
+        # a JSON round-trip decouples the stored tree from caller-owned
+        # (and possibly later-mutated) metadata structures
+        rec = {"meta": json.loads(json.dumps(meta)), "keys": keys,
+               "chunks": chunks}
+        with self._lock:
+            self._steps[int(step)] = rec
+        _tm.count("checkpoint.replications")
+        if _tm.enabled():
+            # cold path: one event per replicated step
+            _tm.event("checkpoint", "replicate", step=int(step),
+                      chunks=len(chunks), bytes=total,
+                      cross_domain=_dm.is_cross_domain(bmap))
+        return {"chunks": len(chunks), "bytes": total,
+                "cross_domain": _dm.is_cross_domain(bmap)}
+
+    # -- retrieval ---------------------------------------------------------
+
+    def fetch(self, step: int, live_ranks=None):
+        """Reassemble ``(meta, arrays, info)`` for ``step`` from replica
+        chunks reachable through live ranks.  Raises ``KeyError`` when
+        the step was never replicated, :class:`PeerReplicaUnavailable`
+        when a chunk's owner AND holder are both down, and
+        :class:`CheckpointIntegrityError` on a per-chunk CRC mismatch."""
+        with self._lock:
+            rec = self._steps.get(int(step))
+            if rec is None:
+                raise KeyError(f"no peer replica for step {step}")
+        if live_ranks is None:
+            from ..resilience import elastic as _el
+            live_ranks = _el.manager().live_ranks()
+        live = {int(r) for r in live_ranks}
+        arrays: dict[str, np.ndarray] = {}
+        for key, (shape, dstr) in rec["keys"].items():
+            arrays[key] = np.empty(shape, dtype=np.dtype(dstr))
+        n_local = n_peer = 0
+        bad: list[str] = []
+        for (key, j), ch in rec["chunks"].items():
+            if ch["owner"] in live:
+                n_local += 1
+            elif ch["holder"] in live:
+                n_peer += 1
+            else:
+                raise PeerReplicaUnavailable(step, key, j, ch["owner"],
+                                             ch["holder"])
+            if int(zlib.crc32(ch["data"])) != ch["crc"]:
+                bad.append(key)
+                continue
+            sl = tuple(slice(a, b) for a, b in ch["slices"])
+            dst = arrays[key]
+            cshape = tuple(b - a for a, b in ch["slices"])
+            dst[sl] = np.frombuffer(
+                ch["data"], dtype=dst.dtype).reshape(cshape)
+        if bad:
+            _tm.count("checkpoint.integrity_failures")
+            raise CheckpointIntegrityError(f"<peer replica step {step}>",
+                                           sorted(set(bad)))
+        if n_peer:
+            _tm.count("checkpoint.peer_fetches", n=n_peer)
+        info = {"local_chunks": n_local, "peer_chunks": n_peer}
+        if _tm.enabled():
+            # cold path: one event per replica restore
+            _tm.event("checkpoint", "replica_fetch", step=int(step),
+                      **info)
+        return rec["meta"], arrays, info
+
+    # -- inventory ---------------------------------------------------------
+
+    def steps(self) -> list[int]:
+        with self._lock:
+            return sorted(self._steps)
+
+    def drop(self, step: int) -> None:
+        with self._lock:
+            self._steps.pop(int(step), None)
+
+    def drop_from(self, step: int) -> list[int]:
+        with self._lock:
+            dropped = sorted(s for s in self._steps if s >= int(step))
+            for s in dropped:
+                del self._steps[s]
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._steps.clear()
+
+
 class CheckpointManager:
     """Stepped checkpoints with async save and ``max_to_keep`` rotation.
 
@@ -374,12 +581,24 @@ class CheckpointManager:
     _STEP = "step_{:08d}"
 
     def __init__(self, directory: str | os.PathLike,
-                 max_to_keep: int | None = 3, async_save: bool = True):
+                 max_to_keep: int | None = 3, async_save: bool = True,
+                 keep_quarantined: int | None = 4,
+                 replicas: PeerReplicaStore | None = None):
         if max_to_keep is not None and max_to_keep < 1:
             raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        if keep_quarantined is not None and keep_quarantined < 0:
+            raise ValueError(f"keep_quarantined must be >= 0, got "
+                             f"{keep_quarantined}")
         self.directory = Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.max_to_keep = max_to_keep
+        # quarantined (corrupt) step dirs kept for forensics; older ones
+        # are reaped during save so they cannot accumulate forever
+        # (None = keep all)
+        self.keep_quarantined = keep_quarantined
+        # peer replica tier: replicate each published step into buddy
+        # memory and restore from there first (None = disk only)
+        self._replicas = replicas
         self._async = bool(async_save)
         self._pool = (ThreadPoolExecutor(max_workers=1,
                                          thread_name_prefix="ckpt")
@@ -443,6 +662,20 @@ class CheckpointManager:
             raise
 
     def _publish(self, step: int, meta, arrays, store: str) -> None:
+        # peer replication FIRST (it is memory-speed; the disk write
+        # dominates), so a crash mid-write still leaves the in-memory
+        # replica restorable.  Best-effort: a replication failure must
+        # never lose the durable tier.
+        if self._replicas is not None:
+            try:
+                self._replicas.put(step, meta, arrays)
+            except Exception as e:  # noqa: BLE001 — disk tier still publishes
+                _tm.count("checkpoint.replication_failures")
+                if _tm.enabled():
+                    # cold path: a failed replication is exceptional
+                    _tm.event("checkpoint", "replication_failure",
+                              step=step,
+                              error=f"{type(e).__name__}: {str(e)[:200]}")
         final = self._step_dir(step)
         tmp = self.directory / f".tmp_{self._STEP.format(step)}"
         if tmp.exists():
@@ -457,6 +690,7 @@ class CheckpointManager:
                   arrays=len(arrays),
                   bytes=int(sum(a.nbytes for a in arrays.values())))
         self._rotate()
+        self._reap_quarantine()
 
     def _rotate(self) -> None:
         if self.max_to_keep is None:
@@ -464,6 +698,31 @@ class CheckpointManager:
         done = self.steps()
         for s in done[:max(0, len(done) - self.max_to_keep)]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            if self._replicas is not None:
+                self._replicas.drop(s)
+        if self._replicas is not None:
+            # replica-only steps (disk write failed) rotate on the same
+            # census, or the memory tier would grow unboundedly
+            reps = self._replicas.steps()
+            for s in reps[:max(0, len(reps) - self.max_to_keep)]:
+                self._replicas.drop(s)
+
+    def _reap_quarantine(self) -> None:
+        """Bound the ``.quarantine_step_*`` forensic stash: keep the
+        newest ``keep_quarantined`` (by step, which the zero-padded name
+        sorts), reap the rest oldest-first, journaling each reap."""
+        if self.keep_quarantined is None:
+            return
+        quarantined = sorted(
+            p for p in self.directory.iterdir()
+            if p.is_dir() and p.name.startswith(".quarantine_step_"))
+        for p in quarantined[:max(0,
+                                  len(quarantined) - self.keep_quarantined)]:
+            shutil.rmtree(p, ignore_errors=True)
+            _tm.count("checkpoint.quarantine_reaps")
+            if _tm.enabled():
+                # cold path: reaping is rarer than quarantining
+                _tm.event("checkpoint", "quarantine_reap", path=p.name)
 
     def _reap(self, wait: bool) -> None:
         still, first_exc = {}, None
@@ -487,29 +746,76 @@ class CheckpointManager:
 
     # -- restore / lifecycle ----------------------------------------------
 
+    def _restore_replica(self, step: int):
+        """Try the peer-replica tier for one step.  Returns the decoded
+        tree, or None when no replica exists / the replica cannot serve
+        (chunk owners+holders all down, CRC mismatch) — the caller falls
+        back to disk.  A CRC-bad replica is evicted like a quarantined
+        disk step (the bytes are provably wrong forever)."""
+        if self._replicas is None:
+            return None
+        try:
+            meta, arrays, info = self._replicas.fetch(step)
+            out = _decode(meta, arrays)
+        except KeyError:
+            return None                      # never replicated: not a fault
+        except Exception as e:  # noqa: BLE001 — disk tier is the fallback
+            _tm.count("checkpoint.replica_fallbacks")
+            if _tm.enabled():
+                # cold path: an unservable replica is exceptional
+                _tm.event("checkpoint", "replica_fallback", step=step,
+                          error=f"{type(e).__name__}: {str(e)[:200]}")
+            if isinstance(e, CheckpointIntegrityError):
+                self._replicas.drop(step)
+            return None
+        _tm.count("checkpoint.restore_source", source="peer")
+        _tm.count("checkpoint.restores")
+        if _tm.enabled():
+            # cold path: one event per restore
+            _tm.event("checkpoint", "restore_peer", step=step, **info)
+        return out
+
     def restore(self, step: int | None = None) -> Any:
         """Load ``step``; with no step given, the latest *restorable*
-        one.  A partially-published step directory — no publish marker
-        (``steps()`` already skips those), or a marker whose payload is
-        missing/corrupt (a crash or fault mid-write) — is skipped with a
-        journaled fallback to the previous complete step instead of
-        raising mid-restore; an explicitly requested ``step`` stays
-        strict."""
+        one.  With a peer-replica store attached the replica tier is
+        tried FIRST (memory/interconnect speed, zero disk reads —
+        ``checkpoint.restore_source`` records which tier served); disk
+        is the fallback.  A partially-published step directory — no
+        publish marker (``steps()`` already skips those), or a marker
+        whose payload is missing/corrupt (a crash or fault mid-write) —
+        is skipped with a journaled fallback to the previous complete
+        step instead of raising mid-restore; an explicitly requested
+        ``step`` stays strict on the disk tier."""
         self.wait()
         if step is not None:
+            out = self._restore_replica(step)
+            if out is not None:
+                return out
             d = self._step_dir(step)
             if not (d / _META).exists():
                 raise FileNotFoundError(f"no checkpoint for step {step} in "
                                         f"{self.directory}")
-            return load(d)
+            out = load(d)
+            _tm.count("checkpoint.restore_source", source="disk")
+            return out
         done = self.steps()
-        if not done:
+        rep_steps = self._replicas.steps() if self._replicas is not None \
+            else []
+        candidates = sorted(set(done) | set(rep_steps))
+        if not candidates:
             raise FileNotFoundError(
                 f"no completed checkpoints in {self.directory}")
         last_exc: BaseException | None = None
-        for s in reversed(done):
+        for s in reversed(candidates):
+            out = self._restore_replica(s)
+            if out is not None:
+                return out
+            if s not in done:
+                continue                     # replica-only step: no disk dir
             try:
-                return load(self._step_dir(s))
+                out = load(self._step_dir(s))
+                _tm.count("checkpoint.restore_source", source="disk")
+                return out
             except Exception as e:  # noqa: BLE001 — fall back, then re-raise
                 last_exc = e
                 _tm.count("checkpoint.restore_fallbacks")
@@ -560,6 +866,11 @@ class CheckpointManager:
         dropped = [s for s in self.steps() if s >= step]
         for s in dropped:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        if self._replicas is not None:
+            # the memory tier rewinds with the disk tier, or a future
+            # peer-first restore would resurrect the abandoned timeline
+            dropped = sorted(set(dropped)
+                             | set(self._replicas.drop_from(step)))
         if dropped and _tm.enabled():
             # cold path: a timeline rewind is a recovery-path event
             _tm.event("checkpoint", "discard_from", step=step,

@@ -40,8 +40,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 from distributedarrays_tpu.parallel import multihost  # noqa: E402
 from distributedarrays_tpu.parallel.collectives import shard_map_compat  # noqa: E402
 
-multihost.initialize(coordinator_address=f"localhost:{port}",
-                     num_processes=nprocs, process_id=proc_id)
+try:
+    # bounded cluster formation: a coordinator that never comes up must
+    # exit with a diagnosable marker, not hang the tier-1 budget — the
+    # parent turns exit code 4 into a bounded diagnostic failure
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs, process_id=proc_id,
+        initialization_timeout_s=int(
+            os.environ.get("DA_TPU_MH_INIT_TIMEOUT_S", "60")))
+except Exception as e:  # noqa: BLE001 — marker protocol for the parent
+    print(f"MULTIHOST_STARTUP_FAILED: {type(e).__name__}: "
+          f"{str(e).splitlines()[0] if str(e) else ''}", flush=True)
+    sys.exit(4)
 
 info = multihost.process_info()
 assert info["process_count"] == nprocs, info
@@ -52,11 +63,25 @@ assert info["global_devices"] == N, info
 mesh = multihost.global_mesh((N,), ("x",))
 
 # --- one psum across all processes (compiled collective over "DCN") -------
+# this first compiled cross-process collective is also the CAPABILITY
+# probe: some backends form the cluster fine but cannot COMPILE
+# multiprocess computations (CPU: "Multiprocess computations aren't
+# implemented on the CPU backend").  That is a missing runtime
+# capability, not a bug in this framework — exit with the typed marker
+# (code 3) so the parent skips, naming the capability, instead of failing
 sh = NamedSharding(mesh, P("x"))
 host = np.arange(float(N), dtype=np.float32)
 garr = jax.make_array_from_callback((N,), sh, lambda idx: host[idx])
-total = jax.jit(shard_map_compat(lambda x: jax.lax.psum(jnp.sum(x), "x"),
+try:
+    total = jax.jit(shard_map_compat(lambda x: jax.lax.psum(jnp.sum(x), "x"),
                               mesh=mesh, in_specs=P("x"), out_specs=P()))(garr)
+except Exception as e:  # noqa: BLE001 — marker protocol for the parent
+    msg = str(e).splitlines()[0] if str(e) else ""
+    if "implemented" in str(e):
+        print(f"MULTIHOST_CAPABILITY_MISSING: {type(e).__name__}: {msg}",
+              flush=True)
+        sys.exit(3)
+    raise
 assert float(total.addressable_data(0)) == N * (N - 1) / 2, total
 
 # --- one DArray constructed across processes ------------------------------

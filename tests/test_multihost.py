@@ -50,6 +50,20 @@ def _run_cluster(stage: str, timeout: int, nprocs: int = 2):
         for p in procs:
             p.kill()
         pytest.fail(f"multihost children hung; partial output: {outs}")
+    # typed-marker protocol (see _multihost_child.py): exit 3 = the
+    # runtime formed the cluster but cannot compile multiprocess
+    # computations — a missing backend capability, skip naming it; exit
+    # 4 = cluster formation itself failed within the bounded init
+    # timeout — a diagnosable failure, never a silent hang
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode == 3 and "MULTIHOST_CAPABILITY_MISSING" in out:
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith("MULTIHOST_CAPABILITY_MISSING"))
+            pytest.skip("multihost runtime capability missing: "
+                        + line.split(": ", 1)[1])
+        if p.returncode == 4 and "MULTIHOST_STARTUP_FAILED" in out:
+            pytest.fail(f"multihost cluster formation failed "
+                        f"(proc {i}, bounded init timeout):\n{out}")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert f"MULTIHOST_OK proc={i}" in out, out
