@@ -31,7 +31,8 @@ from .. import telemetry as _tm
 
 __all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
            "host_local_slice", "gather_global", "heartbeat",
-           "down_peer_processes", "quorum_assess"]
+           "down_peer_processes", "quorum_assess",
+           "exchange_clock_offsets"]
 
 
 def _init_timeout_kw(initialization_timeout_s: int | None) -> dict:
@@ -105,23 +106,68 @@ def _kv_client():
 
 
 _HB_PREFIX = "dat/heartbeat/"
+_CLOCK_PREFIX = "dat/clock/"
 
 
 def heartbeat() -> bool:
     """Publish this controller process's liveness timestamp to the
     coordination service's KV store.  Call it periodically (the elastic
     manager's probe loop does); peers read it via
-    :func:`down_peer_processes`.  Returns False (no-op) single-process
-    or when the distributed client is unavailable."""
+    :func:`down_peer_processes`.  The same write doubles as this host's
+    clock sample for :func:`exchange_clock_offsets` (value format:
+    ``"<epoch> <hostname>"``; a bare epoch from older writers still
+    parses).  Returns False (no-op) single-process or when the
+    distributed client is unavailable."""
     client = _kv_client()
     if client is None:
         return False
     try:  # pragma: no cover — needs a real multi-controller job
+        now = time.time()
         client.key_value_set(f"{_HB_PREFIX}{jax.process_index()}",
-                             f"{time.time():.3f}", allow_overwrite=True)
+                             f"{now:.3f}", allow_overwrite=True)
+        client.key_value_set(f"{_CLOCK_PREFIX}{jax.process_index()}",
+                             f"{now:.6f} {_tm.core._HOST}",
+                             allow_overwrite=True)
         return True
     except Exception:  # pragma: no cover
         return False
+
+
+def exchange_clock_offsets(journal: bool = True) -> dict[int, dict]:
+    """Estimate this host's wall-clock skew against every heartbeating
+    peer: ``{peer_process: {"offset_s": mine - theirs, "host": name}}``.
+
+    Offsets ride the same coordination-service KV as the heartbeat (each
+    :func:`heartbeat` publishes a ``dat/clock/<idx>`` sample); a read of
+    a peer's last sample against our clock bounds the skew to within one
+    heartbeat period — coarse, but enough for the offline journal merge
+    (``telemetry.cluster.merge_journals``) to align per-host timelines.
+    With ``journal=True`` the estimate lands as one ``multihost/clock``
+    event, which is exactly what the merger looks for.  Single-process
+    (or no distributed client): empty dict, nothing journaled."""
+    client = _kv_client()
+    if client is None:
+        return {}
+    offsets: dict[int, dict] = {}
+    me = jax.process_index()  # pragma: no cover — needs real multi-host
+    for p in range(jax.process_count()):  # pragma: no cover
+        if p == me:
+            continue
+        try:
+            raw = client.key_value_try_get(f"{_CLOCK_PREFIX}{p}")
+            if not raw:
+                continue
+            parts = str(raw).split(None, 1)
+            theirs = float(parts[0])
+            host = parts[1].strip() if len(parts) > 1 else f"process-{p}"
+            offsets[p] = {"offset_s": round(time.time() - theirs, 6),
+                          "host": host}
+        except Exception:
+            continue           # an unreadable peer sample is no estimate
+    if offsets and journal and _tm.enabled():  # pragma: no cover
+        _tm.event("multihost", "clock", process_index=me,
+                  offsets={str(k): v for k, v in offsets.items()})
+    return offsets  # pragma: no cover
 
 
 def down_peer_processes(stale_s: float = 30.0) -> set[int]:

@@ -234,6 +234,11 @@ class ElasticDeviceSet:
         try:
             from ..parallel import multihost as _mh
             _mh.heartbeat()
+            # clock skew ride-along: the heartbeat just published this
+            # controller's wall clock; the offsets it reads back become
+            # the multihost/clock journal events the cross-host merge
+            # (telemetry.cluster.merge_journals) aligns timelines with
+            _mh.exchange_clock_offsets()
             stale = _mh.down_peer_processes()
             if stale:  # pragma: no cover — needs real multi-host
                 for i, dev in enumerate(devs):

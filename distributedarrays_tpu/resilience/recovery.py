@@ -75,10 +75,15 @@ class MinorityPartitionExit(RuntimeError):
     shutdown, not a crash."""
 
     def __init__(self, message: str, *, side: list[int] | None = None,
-                 lost: list[int] | None = None):
+                 lost: list[int] | None = None,
+                 incident: str | None = None):
         super().__init__(message)
         self.side = list(side or [])
         self.lost = list(lost or [])
+        # the incident id the adjudication happened under, so downstream
+        # handlers (the serve drain path) can stamp their own events with
+        # it after the recovery loop has closed the incident
+        self.incident = incident
 
 
 @dataclasses.dataclass(frozen=True)
@@ -166,6 +171,13 @@ def _partition_quorum(exc: BaseException) -> dict:
             expected = _dom.topology().ranks()
             q = _dom.majority_side(e.groups, e.observer,
                                    expected_total=len(expected))
+            if _tm.enabled():
+                # mirror quorum_assess's journal witness so a merged
+                # cross-host timeline shows the verdict from BOTH sides
+                # of the split, whichever adjudication path ran
+                _tm.event("multihost", "quorum", verdict=q["verdict"],
+                          side=q["side"], lost=q["lost"],
+                          reason="injected partition (fault plan)")
             return {**q, "reason": "injected partition (fault plan)"}
     from ..parallel import multihost as _mh
     return _mh.quorum_assess()
@@ -264,6 +276,13 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             # Freshness is witnessed by the crash-bundle counter, not the
             # return value (memory-only mode returns None even when a
             # bundle WAS assembled).
+            # mint (or join) the incident at the first classified
+            # failure — BEFORE the postmortem is assembled, so the
+            # bundle itself carries the id: from here until resolution
+            # every journal event and bundle correlates across hosts
+            # offline.  begin_incident is re-entrant, so the possibly
+            # bundle-refined verdict below never re-mints.
+            _tm.begin_incident(classify(e))
             n0 = _tm.flight.crash_bundle_count()
             _tm.flight.record_crash(e, where="recovery")
             fresh = _tm.flight.crash_bundle_count() > n0
@@ -285,10 +304,13 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                         _tm.event("recovery", "minority_exit",
                                   side=q["side"], lost=q["lost"],
                                   reason=q.get("reason", ""))
+                    inc = _tm.current_incident()
+                    _tm.end_incident("minority_exit")
                     raise MinorityPartitionExit(
                         f"partition minority side {q['side']} lost quorum "
                         f"(lost contact with {q['lost']}): exiting cleanly",
-                        side=q["side"], lost=q["lost"]) from e
+                        side=q["side"], lost=q["lost"],
+                        incident=inc) from e
             retries_used = attempt - 1
             interrupted = stop_event is not None and stop_event.is_set()
             remaining = _remaining()
@@ -311,6 +333,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
                           error=f"{type(e).__name__}: {str(e)[:300]}")
             if not retryable:
                 _tm.count("recovery.giveups", verdict=verdict)
+                _tm.end_incident("gave_up")
                 raise
             if verdict == "timeout":
                 timeout_retries += 1
@@ -353,6 +376,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             if remaining is not None and remaining <= 0:
                 _tm.count("recovery.deadline_exceeded", verdict=verdict)
                 _tm.count("recovery.giveups", verdict=verdict)
+                _tm.end_incident("gave_up")
                 raise
             # interruptible backoff: a drain/shutdown signal wakes the
             # sleep promptly and abandons the retry with the pending
@@ -366,6 +390,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             elif stop_event.wait(delay):
                 _tm.count("recovery.interrupted", verdict=verdict)
                 _tm.count("recovery.giveups", verdict=verdict)
+                _tm.end_incident("gave_up")
                 raise
             _tm.count("recovery.retries", verdict=verdict)
             continue
@@ -374,6 +399,7 @@ def run_with_recovery(fn, *args, policy: RetryPolicy | None = None,
             if _tm.enabled():
                 # cold path: one event per recovered run
                 _tm.event("recovery", "recovered", attempts=attempt)
+            _tm.end_incident("recovered")
         return out
 
 

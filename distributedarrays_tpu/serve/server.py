@@ -316,6 +316,13 @@ class Server:
         with _tm.trace_ctx(*(r.trace_id for r in live)):
             self._dispatch_traced(ep, live)
 
+    def _record_latency(self, dt: float) -> None:
+        self._admission.latency.record(dt)
+        # rolling p99 as a gauge: the alerts module's serve_p99 burn-rate
+        # rule (and any scraper) samples it without reaching into the
+        # admission controller
+        _tm.set_gauge("serve.request_p99_s", self._admission.latency.p99())
+
     def _dispatch_traced(self, ep: Endpoint, live: list[Request]) -> None:
         payloads = [r.payload for r in live]
         t0 = time.monotonic()
@@ -341,16 +348,19 @@ class Server:
             # fail the batch Draining — the client-visible story is
             # "server going away", not a generic dispatch failure
             dt = time.monotonic() - t0
-            self._admission.latency.record(dt)
+            self._record_latency(dt)
             with self._lock:
                 self._draining = True
             self._drain_wake.set()
             _tm.count("serve.partition_drains")
             _tm.count("serve.failed", n=len(live), endpoint=ep.name)
             if _tm.enabled():
-                # cold path: one event per partition drain
+                # cold path: one event per partition drain; the exit
+                # carries the (already-closed) incident id so the drain
+                # attributes to the episode without window guessing
+                extra = {"incident": e.incident} if e.incident else {}
                 _tm.event("serve", "partition_drain", side=e.side,
-                          lost=e.lost, endpoint=ep.name)
+                          lost=e.lost, endpoint=ep.name, **extra)
             err = Draining("server lost partition quorum; draining")
             err.__cause__ = e
             for r in live:
@@ -358,7 +368,7 @@ class Server:
             return
         except Exception as e:  # noqa: BLE001 — typed and shipped to futures
             dt = time.monotonic() - t0
-            self._admission.latency.record(dt)
+            self._record_latency(dt)
             err = e if isinstance(e, ServeError) else RequestFailed(
                 f"batch dispatch failed after recovery gave up "
                 f"(endpoint={ep.name}, size={len(live)}): "
@@ -370,7 +380,7 @@ class Server:
                 r.fail(err)
             return
         dt = time.monotonic() - t0
-        self._admission.latency.record(dt)
+        self._record_latency(dt)
         _tm.observe("serve.batch_latency_s", dt, endpoint=ep.name)
         _tm.observe("serve.batch_size", len(live), endpoint=ep.name)
         if not isinstance(results, (list, tuple)) or \

@@ -38,7 +38,8 @@ Metric catalog and the journal schema: ``docs/telemetry.md``.
 from .core import (enabled, enable, disable, configure, reset, count,
                    set_gauge, observe, event, record_comm, counter_value,
                    gauge_value, comm_bytes, events, journal_path, nbytes_of,
-                   report, dump)
+                   report, dump, begin_incident, current_incident,
+                   end_incident)
 from .summarize import read_journal, summarize, format_summary
 from .tracing import (Span, span, traced, current_span, current_span_id,
                       spans, span_stats, open_spans, annotate, trace_ctx,
@@ -50,19 +51,33 @@ from . import flight
 from . import perf
 from . import regress
 from . import tracing
+from . import cluster
+from . import alerts
 from .memory import leak_census
 from .flight import postmortem, record_crash
+from .cluster import merge_journals, reconstruct_incidents
+from .alerts import AlertRule, AlertManager, default_rules, start_sampler, \
+    stop_sampler
 
 __all__ = [
     "enabled", "enable", "disable", "configure", "reset",
     "count", "set_gauge", "observe", "event", "record_comm",
     "counter_value", "gauge_value", "comm_bytes", "events",
     "journal_path", "nbytes_of", "report", "dump",
+    "begin_incident", "current_incident", "end_incident",
     "read_journal", "summarize", "format_summary",
     "Span", "span", "traced", "current_span", "current_span_id",
     "spans", "span_stats", "open_spans", "annotate", "trace_ctx",
     "current_trace_ids", "bind_trace_ids", "record_external_span",
     "to_perfetto", "to_prometheus",
-    "memory", "flight", "perf", "regress", "tracing",
+    "memory", "flight", "perf", "regress", "tracing", "cluster", "alerts",
     "leak_census", "postmortem", "record_crash",
+    "merge_journals", "reconstruct_incidents",
+    "AlertRule", "AlertManager", "default_rules",
+    "start_sampler", "stop_sampler",
 ]
+
+# arm the always-on health sampler when the env interval is set — same
+# import-time auto-install pattern as flight's SIGUSR1 handler; with
+# DA_TPU_TELEMETRY=0 or no interval this is a no-op
+alerts._maybe_autostart()

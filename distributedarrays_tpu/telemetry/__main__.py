@@ -11,6 +11,9 @@ Usage::
         [--min-findings N] [--json]
     python -m distributedarrays_tpu.telemetry regress FRESH.json
         [--baseline DIR_OR_FILE ...] [--json] [--strict]
+    python -m distributedarrays_tpu.telemetry incident RUN.jsonl [RUN2.jsonl
+        ...] [--bundles DIR_OR_FILE ...] [--json] [--trace OUT.json]
+        [--strict-bundles]
     python -m distributedarrays_tpu.telemetry RUN.jsonl [--json]   # legacy
 
 ``summarize`` prints event counts by category (grouped per host when the
@@ -27,13 +30,20 @@ performance observatory (roofline classification of cost-stamped spans,
 comm/compute overlap, the critical path, ranked findings — see
 ``telemetry/perf.py``); ``regress`` judges a fresh bench run against the
 banked ``BENCH_r*`` trajectory with noise-aware thresholds and exits 1
-on a significant slowdown (``telemetry/regress.py``).  ``-`` reads
-stdin.  The first form without a subcommand is the PR-1 interface and
-behaves exactly like ``summarize``.
+on a significant slowdown (``telemetry/regress.py``); ``incident``
+merges one or more per-host journals onto a single timeline and
+reconstructs ordered incident reports from them plus any flight bundles
+(``telemetry/cluster.py``) — ``--trace`` additionally writes the merged
+Perfetto trace with incident flow arrows, and ``--strict-bundles``
+exits 1 if any bundle or recovery attempt could not be attributed (the
+CI orphan gate).  ``-`` reads stdin.  The first form without a
+subcommand is the PR-1 interface and behaves exactly like ``summarize``.
 
-A missing, empty, or size-cap-truncated journal exits with a one-line
-message and status 2 (the cap message carries the ``journal.capped``
-latch fields so the truncation is visible) instead of a traceback.
+A missing or empty journal exits with a one-line message and status 2
+instead of a traceback.  At the size cap journals now ROTATE to
+``<path>.1`` (the ``incident``/``summarize`` readers pick the sibling up
+automatically); a legacy ``journal.capped`` latch from an older writer
+still exits 2 with the truncation details.
 
 The converters (``summarize.py``, ``export.py``, ``memory.py``) are pure
 stdlib; running via ``-m`` imports the parent package (JAX present), so
@@ -45,6 +55,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import os
 import sys
 
 from .export import to_perfetto, to_prometheus
@@ -52,7 +63,15 @@ from .summarize import read_journal, summarize, format_summary, _fmt_bytes
 
 
 def _read_events(path: str) -> list[dict]:
-    return read_journal(sys.stdin if path == "-" else path)
+    if path == "-":
+        return read_journal(sys.stdin)
+    events: list[dict] = []
+    if os.path.exists(path + ".1"):
+        # rotated sibling from the size cap: oldest generation first so
+        # the timeline reads in order
+        events.extend(read_journal(path + ".1"))
+    events.extend(read_journal(path))
+    return events
 
 
 class _JournalUnusable(Exception):
@@ -66,12 +85,15 @@ def _check_events(events: list[dict], path: str) -> list[dict]:
                 if e.get("cat") == "journal" and e.get("name") == "capped"),
                None)
     if cap is not None:
+        # legacy latch (pre-rotation writers, or a writer whose rotation
+        # os.replace failed): the file is truncated, not rotated
         raise _JournalUnusable(
             f"journal is cap-truncated: {path} stopped at "
             f"{cap.get('bytes_written', '?')} bytes "
             f"(max {cap.get('max_bytes', '?')}; journal.capped at "
             f"t={cap.get('t', '?')}) — raise "
-            f"DA_TPU_TELEMETRY_JOURNAL_MAX_MB and rerun")
+            f"DA_TPU_TELEMETRY_JOURNAL_MAX_MB and rerun "
+            f"(current writers rotate to {path}.1 instead)")
     return events
 
 
@@ -279,6 +301,14 @@ def _cmd_regress(args) -> int:
               file=sys.stderr)
         return 2
     baseline = rg.load_baseline(args.baseline or ["."])
+    if not any(baseline.values()):
+        # an empty or all-replay bank is not a baseline: every banked row
+        # was itself a replay of an older number, so there is no live
+        # trajectory to judge drift against
+        print("NO_LIVE_TRAJECTORY: banked baseline has no live "
+              "measurements (empty or all replays) — nothing to judge "
+              "against", file=sys.stdout)
+        return 2 if args.strict else 0
     results = rg.compare(fresh, baseline, mad_k=args.mad_k,
                          rel_floor=args.rel_floor)
     if args.json:
@@ -293,6 +323,46 @@ def _cmd_regress(args) -> int:
               "against", file=sys.stderr)
         return 2 if args.strict else 0
     if any(r["status"] == "regression" for r in judged):
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# incident: cross-host merge + causal incident reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _cmd_incident(args) -> int:
+    from . import cluster
+    per_host: list[list[dict]] = []
+    for path in args.journals:
+        evs = _check_events(_read_events(path), path)
+        per_host.append(evs)
+    merged = cluster.merge_journals(per_host, slack_s=args.slack)
+    try:
+        bundles = cluster.load_bundles(args.bundles or [])
+    except ValueError as e:
+        print(f"incident: {e}", file=sys.stderr)
+        return 2
+    report = cluster.reconstruct_incidents(merged, bundles,
+                                           slack_s=args.slack)
+    if args.trace:
+        trace = cluster.incident_trace(merged, report)
+        with open(args.trace, "w") as f:
+            json.dump(trace, f)
+        print(f"merged trace with incident flows -> {args.trace}",
+              file=sys.stderr)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        cluster.format_incidents(report, sys.stdout)
+    if args.strict_bundles and (report["bundles_unattributed"]
+                                or report["unattributed_recovery_events"]):
+        print(f"incident: {len(report['bundles_unattributed'])} orphaned "
+              f"bundle(s), {report['unattributed_recovery_events']} "
+              f"unattributed recovery event(s) — reconstruction is "
+              f"incomplete", file=sys.stderr)
         return 1
     return 0
 
@@ -354,7 +424,7 @@ def _cmd_postmortem(args) -> int:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] in ("summarize", "trace", "prom", "mem",
-                            "postmortem", "doctor", "regress"):
+                            "postmortem", "doctor", "regress", "incident"):
         ap = argparse.ArgumentParser(
             prog="python -m distributedarrays_tpu.telemetry",
             description="Summarize or export a telemetry journal/report.")
@@ -418,6 +488,27 @@ def main(argv=None) -> int:
         p.add_argument("--json", action="store_true",
                        help="emit results as JSON")
         p.set_defaults(fn=_cmd_regress)
+        p = sub.add_parser("incident",
+                           help="merge per-host journals and reconstruct "
+                                "ordered incident reports")
+        p.add_argument("journals", nargs="+",
+                       help="per-host JSONL journal paths ('-' = stdin); "
+                            "rotated <path>.1 siblings read automatically")
+        p.add_argument("--bundles", action="append", default=None,
+                       help="flight-bundle file or directory (scanned for "
+                            "*.json postmortems); repeatable")
+        p.add_argument("--trace", default=None, metavar="OUT.json",
+                       help="also write the merged Perfetto trace with "
+                            "incident flow arrows")
+        p.add_argument("--slack", type=float, default=5.0,
+                       help="seconds of window slack for attributing "
+                            "unstamped events/bundles (default 5)")
+        p.add_argument("--strict-bundles", action="store_true",
+                       help="exit 1 if any bundle or recovery attempt "
+                            "is unattributed (CI orphan gate)")
+        p.add_argument("--json", action="store_true",
+                       help="emit the incident report as JSON")
+        p.set_defaults(fn=_cmd_incident)
         args = ap.parse_args(argv)
         try:
             return args.fn(args)

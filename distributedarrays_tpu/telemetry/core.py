@@ -46,6 +46,7 @@ __all__ = [
     "counter_value", "gauge_value", "comm_bytes", "events",
     "journal_path", "nbytes_of", "report", "dump",
     "register_report_section", "register_reset_hook",
+    "begin_incident", "current_incident", "end_incident",
 ]
 
 _FALSY = ("0", "false", "off", "no")
@@ -74,7 +75,16 @@ _journal_path: str | None = os.environ.get("DA_TPU_TELEMETRY_JOURNAL") or None
 _journal_file = None       # lazily opened append handle
 _journal_bytes = 0         # bytes written (or pre-existing) at the path
 _journal_max = 0           # size cap, sampled from env at file open
-_journal_capped = False    # True once the size cap stopped file mirroring
+_journal_capped = False    # True only if rotation itself failed (fallback)
+_journal_rotations = 0     # completed .1 rotations at the current path
+
+# the process-wide open incident, if any: failure handling spans threads
+# (recovery retries, serve dispatch workers, the health sampler), so this
+# is plain lock-guarded module state rather than a ContextVar.  Minted at
+# the first classified failure and carried through retries the same way
+# request trace ids ride through dispatch.
+_incident_id: str | None = None
+_incident_seq = 0
 
 # one monotonic origin per process so every event timestamp is comparable
 _T0 = time.monotonic()
@@ -120,10 +130,12 @@ def register_reset_hook(fn) -> None:
 
 def _journal_max_bytes() -> int:
     """Journal file size cap (``DA_TPU_TELEMETRY_JOURNAL_MAX_MB``, default
-    64): mirroring stops — with a single ``journal.capped`` marker event —
-    instead of growing without bound during long bench/watch runs.
-    Sampled once per file open (not per write) — reconfigure() to pick
-    up a changed value."""
+    64): at the cap the file rotates to ``<path>.1`` (one generation kept)
+    and mirroring continues into a fresh file opened with a single
+    ``journal.rotated`` marker event — long soaks with the health sampler
+    armed keep a bounded recent window instead of going blind.  Sampled
+    once per file open (not per write) — reconfigure() to pick up a
+    changed value."""
     try:
         mb = float(os.environ.get("DA_TPU_TELEMETRY_JOURNAL_MAX_MB", "64"))
     except ValueError:
@@ -168,13 +180,14 @@ def disable() -> None:
 def configure(journal_path: str | None) -> None:
     """Set (or clear, with ``None``) the JSONL journal path.  The file is
     opened lazily on the next recorded event, in append mode.  Clears any
-    size-cap latch from a previous path."""
-    global _journal_path, _journal_bytes, _journal_capped
+    size-cap/rotation state from a previous path."""
+    global _journal_path, _journal_bytes, _journal_capped, _journal_rotations
     with _LOCK:
         _close_journal_locked()
         _journal_path = journal_path
         _journal_bytes = 0
         _journal_capped = False
+        _journal_rotations = 0
 
 
 def journal_path() -> str | None:
@@ -185,7 +198,8 @@ def reset() -> None:
     """Clear every metric, the event buffer, and journal dedup state.
     The enabled flag and the configured journal path are kept; an open
     journal file handle is closed (the file itself is left in place)."""
-    global _events_total, _journal_bytes, _journal_capped
+    global _events_total, _journal_bytes, _journal_capped, \
+        _journal_rotations, _incident_id
     with _LOCK:
         _counters.clear()
         _gauges.clear()
@@ -196,6 +210,8 @@ def reset() -> None:
         _events_total = 0
         _journal_bytes = 0
         _journal_capped = False
+        _journal_rotations = 0
+        _incident_id = None
         _close_journal_locked()
         for hook in _reset_hooks:
             hook()
@@ -334,6 +350,8 @@ def event(category: str, name: str | None = None, *,
         tr = _TRACE_CTX.get()
         if tr and "trace_id" not in fields:
             rec["trace_id"] = list(tr)
+        if _incident_id is not None and "incident" not in fields:
+            rec["incident"] = _incident_id
         for k, v in fields.items():
             rec[k] = _jsonable(v)
         _events_total += 1
@@ -351,9 +369,49 @@ def _jsonable(v):
     return str(v)
 
 
+def begin_incident(kind: str = "failure") -> str | None:
+    """Open (or join) the process-wide incident and return its id.
+
+    Minted at the first classified failure (``inc-<host>-<pid>-<n>``);
+    while open, every journal event and flight bundle is stamped with the
+    id, so retries, quorum verdicts, restores, shrinks, drains and
+    bundles from one causal episode correlate across hosts — the same
+    discipline as request trace ids.  Re-entrant: a second classified
+    failure inside an open incident joins it (one ``incident/begin``
+    event per episode).  Returns ``None`` when telemetry is disabled."""
+    global _incident_id, _incident_seq
+    if not _ENABLED:
+        return None
+    with _LOCK:
+        if _incident_id is not None:
+            return _incident_id
+        _incident_seq += 1
+        _incident_id = f"inc-{_HOST}-{os.getpid()}-{_incident_seq}"
+        inc = _incident_id
+    event("incident", "begin", kind=kind)
+    return inc
+
+
+def current_incident() -> str | None:
+    """The open incident id, or ``None``."""
+    return _incident_id
+
+
+def end_incident(resolution: str = "resolved") -> None:
+    """Close the open incident (no-op if none): one ``incident/end``
+    event carrying the id and ``resolution`` (``recovered`` /
+    ``minority_exit`` / ``gave_up`` / ...), then stop stamping."""
+    global _incident_id
+    if _incident_id is None:
+        return
+    event("incident", "end", resolution=resolution)
+    with _LOCK:
+        _incident_id = None
+
+
 def _write_journal_locked(rec: dict) -> None:
     global _journal_file, _journal_bytes, _journal_max, _journal_capped, \
-        _events_total
+        _events_total, _journal_rotations
     if _journal_path is None or _journal_capped:
         return
     try:
@@ -372,21 +430,48 @@ def _write_journal_locked(rec: dict) -> None:
         _journal_file.flush()
         _journal_bytes += len(line)
         if _journal_bytes >= _journal_max:
-            # size cap reached: one marker event, then stop mirroring
-            # (the in-memory buffer and all counters keep recording)
-            cap = {"seq": _events_total,
-                   "t": round(time.monotonic() - _T0, 6),
-                   "wall": round(time.time(), 3),
-                   "cat": "journal", "name": "capped",
-                   "host": _HOST, "pid": os.getpid(),
-                   "bytes_written": _journal_bytes,
-                   "max_bytes": _journal_max}
-            _events_total += 1
-            _events.append(cap)
-            _journal_file.write(json.dumps(cap) + "\n")
-            _journal_file.flush()
-            _journal_capped = True
+            # size cap reached: rotate the full file to <path>.1 (one
+            # generation kept — the previous .1, if any, is replaced) and
+            # continue mirroring into a fresh file whose first line is a
+            # single journal.rotated marker, so long sampler-armed soaks
+            # keep a bounded recent window instead of going blind
+            rotated = _journal_bytes
             _close_journal_locked()
+            try:
+                os.replace(_journal_path, _journal_path + ".1")
+            except OSError:
+                # rotation impossible (e.g. cross-device, permissions):
+                # fall back to the pre-rotation latch — marker in the
+                # buffer, file mirroring stops, counters keep recording
+                cap = {"seq": _events_total,
+                       "t": round(time.monotonic() - _T0, 6),
+                       "wall": round(time.time(), 3),
+                       "cat": "journal", "name": "capped",
+                       "host": _HOST, "pid": os.getpid(),
+                       "bytes_written": rotated,
+                       "max_bytes": _journal_max}
+                _events_total += 1
+                _events.append(cap)
+                _journal_capped = True
+                return
+            _journal_rotations += 1
+            _journal_bytes = 0
+            _journal_file = open(_journal_path, "a")
+            marker = {"seq": _events_total,
+                      "t": round(time.monotonic() - _T0, 6),
+                      "wall": round(time.time(), 3),
+                      "cat": "journal", "name": "rotated",
+                      "host": _HOST, "pid": os.getpid(),
+                      "rotated_to": _journal_path + ".1",
+                      "rotation": _journal_rotations,
+                      "bytes_rotated": rotated,
+                      "max_bytes": _journal_max}
+            _events_total += 1
+            _events.append(marker)
+            mline = json.dumps(marker) + "\n"
+            _journal_file.write(mline)
+            _journal_file.flush()
+            _journal_bytes += len(mline)
     except OSError:
         # telemetry must never take down the workload it observes
         _journal_file = None
@@ -505,7 +590,9 @@ def report() -> dict:
                 "by_category": by_cat,
                 "journal_path": _journal_path,
                 "journal_capped": _journal_capped,
+                "journal_rotations": _journal_rotations,
             },
+            "incident": _incident_id,
         }
     # outside _LOCK: section providers take it themselves (RLock would
     # allow reentry, but holding it across foreign code invites deadlock)

@@ -42,12 +42,26 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
     time — the shared monotonic origin makes the two line up.  Every
     entry carries ``ph/ts/dur/pid/tid`` so strict viewers need no
     defaulting.
+
+    Events from more than one ``(host, pid)`` — a merged multihost
+    journal (``cluster.merge_journals``) — render as separate process
+    tracks: each recorder gets its own trace pid (``pid`` is the base)
+    and a ``process_name`` metadata entry, so one trace shows the whole
+    cluster with per-host timelines.
     """
     if spans is None:
         spans = [e for e in events if e.get("cat") == "span"]
     rest = [e for e in events if e.get("cat") != "span"]
     trace = []
-    threads: dict[int, str] = {}
+    threads: dict[tuple[int, int], str] = {}
+    procs: dict[tuple[str, int], int] = {}
+
+    def _pid(e) -> int:
+        key = (str(e.get("host", "")), int(e.get("pid") or 0))
+        p = procs.get(key)
+        if p is None:
+            p = procs[key] = pid + len(procs)
+        return p
     # request flows: spans carrying the same trace id chain together with
     # Chrome flow events (s/t/f), so a serve request's journey — submit,
     # batch dispatch, retries, rank steps — draws as one arrowed path
@@ -55,6 +69,7 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
     for s in spans:
         if s.get("dur") is None:
             continue                       # still-open span snapshot
+        spid = _pid(s)
         tid = int(s.get("tid") or 0)
         labels = s.get("labels") or {}
         rank = labels.get("rank")
@@ -65,27 +80,29 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
             # process-backend spans are recorded parent-side)
             try:
                 tid = _RANK_TRACK_BASE + int(rank)
-                threads.setdefault(tid, f"rank {int(rank)}")
+                threads.setdefault((spid, tid), f"rank {int(rank)}")
             except (TypeError, ValueError):
                 pass
         elif s.get("tname"):
-            threads.setdefault(tid, str(s["tname"]))
+            threads.setdefault((spid, tid), str(s["tname"]))
         args = {k: s[k] for k in ("span_id", "parent_id", "bytes",
                                   "child_bytes", "trace_id")
                 if s.get(k) is not None}
         args.update(labels)
         entry = {"name": str(s.get("name", "?")), "cat": "span",
                  "ph": "X", "ts": _us(s.get("start", 0.0)),
-                 "dur": _us(s["dur"]), "pid": pid, "tid": tid,
+                 "dur": _us(s["dur"]), "pid": spid, "tid": tid,
                  "args": args}
         trace.append(entry)
         for t in (s.get("trace_id") or ()):
             flows.setdefault(str(t), []).append(entry)
     # counter-track state: each "C" event's args define ALL series values
     # at that timestamp, so the missing series must be carried forward or
-    # the renderer drops its line to zero between samples
-    hbm_live = hbm_staging = 0
+    # the renderer drops its line to zero between samples (per process —
+    # merged journals keep one HBM line per host)
+    hbm_state: dict[int, list] = {}
     for e in rest:
+        epid = _pid(e)
         tid = int(e.get("tid") or 0)
         cat = str(e.get("cat", "?"))
         name = e.get("name")
@@ -99,34 +116,36 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
             # provenance, not series identity)
             cname = str(name or "gauge")
             labels = {k: v for k, v in args.items()
-                      if k not in ("value", "span_id", "trace_id")}
+                      if k not in ("value", "span_id", "trace_id",
+                                   "incident", "t_local")}
             if labels:
                 cname += "{" + ",".join(
                     f"{k}={labels[k]}" for k in sorted(labels)) + "}"
             trace.append({"name": cname, "cat": "gauge", "ph": "C",
                           "ts": _us(e.get("t", 0.0)), "dur": 0,
-                          "pid": pid, "tid": 0,
+                          "pid": epid, "tid": 0,
                           "args": {"value": e["value"]}})
             continue
         trace.append({"name": f"{cat}/{name}" if name is not None else cat,
                       "cat": cat, "ph": "i", "s": "t",
                       "ts": _us(e.get("t", 0.0)), "dur": 0,
-                      "pid": pid, "tid": tid, "args": args})
+                      "pid": epid, "tid": tid, "args": args})
         if cat == "hbm":
             # counter ("C") track: the HBM ledger as a line under the
             # span timeline — ledger live bytes and transient staging
             # are two series on one counter
             if e.get("live") is not None or \
                     e.get("staging_live") is not None:
+                state = hbm_state.setdefault(epid, [0, 0])
                 if e.get("live") is not None:
-                    hbm_live = e["live"]
+                    state[0] = e["live"]
                 if e.get("staging_live") is not None:
-                    hbm_staging = e["staging_live"]
+                    state[1] = e["staging_live"]
                 trace.append({"name": "hbm_bytes", "cat": "hbm",
                               "ph": "C", "ts": _us(e.get("t", 0.0)),
-                              "dur": 0, "pid": pid, "tid": 0,
-                              "args": {"live": hbm_live,
-                                       "staging": hbm_staging}})
+                              "dur": 0, "pid": epid, "tid": 0,
+                              "args": {"live": state[0],
+                                       "staging": state[1]}})
     for flow_n, (tid_key, entries) in enumerate(sorted(flows.items())):
         if len(entries) < 2:
             continue                  # a flow needs two ends
@@ -140,9 +159,17 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
             if ph == "f":
                 ev["bp"] = "e"        # bind the finish to the slice start
             trace.append(ev)
-    for tid, tname in sorted(threads.items()):
+    for (tpid, tid), tname in sorted(threads.items()):
         trace.append({"name": "thread_name", "ph": "M", "ts": 0, "dur": 0,
-                      "pid": pid, "tid": tid, "args": {"name": tname}})
+                      "pid": tpid, "tid": tid, "args": {"name": tname}})
+    if len(procs) > 1:
+        # merged multihost journal: name each process track after its
+        # recorder so the per-host timelines are identifiable in the UI
+        for (host, opid), tpid in sorted(procs.items(),
+                                         key=lambda kv: kv[1]):
+            trace.append({"name": "process_name", "ph": "M", "ts": 0,
+                          "dur": 0, "pid": tpid, "tid": 0,
+                          "args": {"name": f"{host or 'host'}:{opid}"}})
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 
@@ -151,7 +178,11 @@ def to_perfetto(events, spans=None, pid: int = 0) -> dict:
 # ---------------------------------------------------------------------------
 
 
-_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
+# DOTALL: a label VALUE may contain a literal newline (core._key does
+# not escape), and a non-matching key would leak the raw newline into
+# the metric name and the HELP line — invalid exposition
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$",
+                     re.DOTALL)
 
 
 # label-list splitter: core._key joins "k=v" pairs with "," WITHOUT

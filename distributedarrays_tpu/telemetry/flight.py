@@ -40,7 +40,14 @@ from . import core, memory, tracing
 
 __all__ = ["postmortem", "record_crash", "last_bundle",
            "install_sigusr1", "register_census_provider",
-           "register_classifier", "crash_bundle_count"]
+           "register_classifier", "crash_bundle_count",
+           "SCHEMA_VERSION"]
+
+# bundle schema version, stamped on every bundle so offline readers (the
+# incident CLI, external tooling) can refuse shapes they don't
+# understand.  v1: unversioned bundles (pre-incident era); v2 adds
+# schema_version + the open incident id.
+SCHEMA_VERSION = 2
 
 _RING_MAX_ENV = "DA_TPU_FLIGHT_RING"       # bundle ring tail length
 _MAX_ENV = "DA_TPU_FLIGHT_MAX"             # bundles per process
@@ -137,6 +144,8 @@ def snapshot_bundle(reason: str, exc=None) -> dict:
             verdict = None               # the recorder must never re-crash
     return {
         "kind": "da_tpu_postmortem",
+        "schema_version": SCHEMA_VERSION,
+        "incident": core.current_incident(),
         "reason": reason,
         "classification": verdict,
         "host": core._HOST,

@@ -54,6 +54,8 @@ def summarize(events: list[dict]) -> dict:
     comm: dict[str, dict] = {}
     fallbacks: dict[str, int] = {}
     spans: dict[str, dict] = {}
+    incidents: set = set()
+    alerts: dict[str, int] = {}
     # per-host rollups: multihost journals are merged by concatenation
     # (every event carries host/pid), so the summary re-groups them
     by_host: dict[str, dict] = {}
@@ -73,6 +75,12 @@ def summarize(events: list[dict]) -> dict:
         if name is not None:
             k = f"{cat}/{name}"
             by_name[k] = by_name.get(k, 0) + 1
+        inc = e.get("incident")
+        if inc:
+            incidents.add(str(inc))
+        if cat == "alert" and name is not None:
+            ak = f"{name}:{e.get('state', '?')}"
+            alerts[ak] = alerts.get(ak, 0) + 1
         if cat == "comm":
             kind = str(name)
             c = comm.setdefault(kind, {"ops": 0, "bytes": 0,
@@ -118,6 +126,8 @@ def summarize(events: list[dict]) -> dict:
         "fallbacks": dict(sorted(fallbacks.items(),
                                  key=lambda kv: (-kv[1], kv[0]))),
         "spans": dict(sorted(spans.items())),
+        "incidents": sorted(incidents),
+        "alerts": dict(sorted(alerts.items())),
     }
 
 
@@ -143,6 +153,17 @@ def format_summary(summary: dict, out: TextIO) -> None:
                              sorted(h["by_category"].items()))
             out.write(f"  {host:<24} {h['events']:>7} events  "
                       f"{_fmt_bytes(h['comm_bytes'])} comm  [{cats}]\n")
+    incidents = summary.get("incidents") or []
+    if incidents:
+        out.write(f"\nincidents ({len(incidents)}): "
+                  f"{', '.join(incidents)}\n")
+        out.write("  (reconstruct: python -m distributedarrays_tpu"
+                  ".telemetry incident <journal...>)\n")
+    alerts = summary.get("alerts") or {}
+    if alerts:
+        out.write("\nalert transitions:\n")
+        for key, n in alerts.items():
+            out.write(f"  {key:<40} {n}\n")
     out.write("\nby category:\n")
     for cat, n in summary["by_category"].items():
         out.write(f"  {cat:<16} {n}\n")

@@ -427,6 +427,9 @@ class Trainer:
                     "rdma" if _dispatch_rdma_count() > rd0 else "xla"
             _tm.annotate(dispatch=self._dispatch.get(progkey, "xla"))
             dur = time.monotonic() - t0
+            # last step wall time as a gauge: the alerts module's
+            # train_step_time burn-rate rule samples it between spans
+            _tm.set_gauge("train.step_s", round(dur, 6))
             # straggler gate BEFORE the update is applied: a confirmed
             # dead rank must abort the step so the recovery retry
             # (restore + shrink) recomputes it — never double-applies

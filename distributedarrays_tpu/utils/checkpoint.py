@@ -797,6 +797,11 @@ class CheckpointManager:
                                         f"{self.directory}")
             out = load(d)
             _tm.count("checkpoint.restore_source", source="disk")
+            if _tm.enabled():
+                # cold path: one event per restore — the disk-tier twin
+                # of restore_peer, so incident reconstruction names which
+                # tier actually served
+                _tm.event("checkpoint", "restore_disk", step=step)
             return out
         done = self.steps()
         rep_steps = self._replicas.steps() if self._replicas is not None \
@@ -815,6 +820,9 @@ class CheckpointManager:
             try:
                 out = load(self._step_dir(s))
                 _tm.count("checkpoint.restore_source", source="disk")
+                if _tm.enabled():
+                    # cold path: one event per restore (see above)
+                    _tm.event("checkpoint", "restore_disk", step=s)
                 return out
             except Exception as e:  # noqa: BLE001 — fall back, then re-raise
                 last_exc = e

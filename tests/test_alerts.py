@@ -1,0 +1,261 @@
+"""SLO burn-rate alerting + health sampler suite: the fast/slow window
+state machine (fire on both burns, clear on half the fast burn —
+hysteresis), the five stock rules, the incremental shed-fraction signal,
+sampler lifecycle (env-gated, idempotent, disabled-mode no-op, tick
+contents), and the Prometheus exposition edge cases the observatory
+leans on: per-endpoint SLO bucket histograms, label escaping, and the
+``da_tpu_alert_active`` gauge family.
+"""
+
+import json
+
+import pytest
+
+from distributedarrays_tpu.telemetry import alerts, core, export
+from distributedarrays_tpu.telemetry.fixtures import telemetry_capture  # noqa: F401 (fixture)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_sampler():
+    yield
+    alerts.stop_sampler()
+    alerts.default_manager().reset()
+
+
+def _p99_rule(**kw):
+    kw.setdefault("fast_window_s", 1.0)
+    kw.setdefault("slow_window_s", 4.0)
+    return alerts.AlertRule(
+        "serve_p99", lambda: core.gauge_value("serve.request_p99_s"),
+        threshold=0.5, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the burn-rate state machine
+# ---------------------------------------------------------------------------
+
+
+def test_alert_fires_on_both_burns_and_clears_with_hysteresis(
+        telemetry_capture):
+    tm = telemetry_capture
+    mgr = alerts.AlertManager([_p99_rule()])
+    tm.set_gauge("serve.request_p99_s", 2.0)          # breaching
+    assert mgr.evaluate(now=10.0)["serve_p99"] is True
+    assert mgr.firing() == ["serve_p99"]
+    fired = [e for e in tm.events("alert") if e["state"] == "firing"]
+    assert len(fired) == 1
+    assert fired[0]["name"] == "serve_p99"
+    assert fired[0]["burn_fast"] == 1.0
+    # healthy samples arrive; while the breach is still inside the fast
+    # window the burn sits at 0.5 > fast_burn/2 -> STAYS firing
+    tm.set_gauge("serve.request_p99_s", 0.01)
+    assert mgr.evaluate(now=10.5)["serve_p99"] is True
+    # once the breach ages out of the fast window the burn drops to 0
+    assert mgr.evaluate(now=11.5)["serve_p99"] is False
+    cleared = [e for e in tm.events("alert") if e["state"] == "cleared"]
+    assert len(cleared) == 1
+    assert mgr.firing() == []
+    # exactly one transition each way, no flapping
+    assert tm.counter_value("alerts.transitions", alert="serve_p99",
+                            state="firing") == 1
+    assert tm.counter_value("alerts.transitions", alert="serve_p99",
+                            state="cleared") == 1
+
+
+def test_alert_needs_the_slow_burn_too(telemetry_capture):
+    tm = telemetry_capture
+    # slow_burn 0.5 over a 10s window: one breaching blip among many
+    # healthy samples must NOT page
+    mgr = alerts.AlertManager([_p99_rule(
+        fast_window_s=1.0, slow_window_s=10.0, slow_burn=0.5)])
+    tm.set_gauge("serve.request_p99_s", 0.01)
+    for i in range(8):
+        assert mgr.evaluate(now=float(i))["serve_p99"] is False
+    tm.set_gauge("serve.request_p99_s", 2.0)
+    # fast burn 1.0 but slow burn 1/9 < 0.5 -> still quiet
+    assert mgr.evaluate(now=8.0)["serve_p99"] is False
+
+
+def test_alert_no_sample_does_not_advance_windows(telemetry_capture):
+    mgr = alerts.AlertManager([_p99_rule()])
+    # gauge never set: signal returns None -> no sample, never fires
+    assert mgr.evaluate(now=1.0)["serve_p99"] is False
+    assert mgr.evaluate(now=2.0)["serve_p99"] is False
+
+
+def test_alert_gauge_mirrors_firing_state(telemetry_capture):
+    tm = telemetry_capture
+    mgr = alerts.AlertManager([_p99_rule()])
+    tm.set_gauge("serve.request_p99_s", 2.0)
+    mgr.evaluate(now=10.0)
+    assert tm.gauge_value("alert.active", alert="serve_p99") == 1.0
+    tm.set_gauge("serve.request_p99_s", 0.01)
+    mgr.evaluate(now=11.5)
+    assert tm.gauge_value("alert.active", alert="serve_p99") == 0.0
+
+
+def test_alert_less_than_op_for_live_devices(telemetry_capture):
+    tm = telemetry_capture
+    rule = alerts.AlertRule(
+        "live_devices", lambda: tm.gauge_value("elastic.live_devices"),
+        threshold=6.0, op="<", fast_window_s=1.0, slow_window_s=4.0)
+    mgr = alerts.AlertManager([rule])
+    tm.set_gauge("elastic.live_devices", 8.0)
+    assert mgr.evaluate(now=1.0)["live_devices"] is False
+    tm.set_gauge("elastic.live_devices", 5.0)
+    assert mgr.evaluate(now=1.5)["live_devices"] is True
+
+
+def test_broken_signal_is_no_sample_not_a_crash(telemetry_capture):
+    def boom():
+        raise RuntimeError("scraper exploded")
+    mgr = alerts.AlertManager([alerts.AlertRule("broken", boom)])
+    assert mgr.evaluate(now=1.0)["broken"] is False
+
+
+def test_default_rules_construction():
+    base = alerts.default_rules()
+    assert [r.name for r in base] == ["serve_p99", "serve_shed"]
+    full = alerts.default_rules(step_time_slo_s=1.0,
+                                hbm_budget_bytes=1 << 30,
+                                min_live_devices=6)
+    assert [r.name for r in full] == [
+        "serve_p99", "serve_shed", "train_step_time", "hbm_live",
+        "live_devices"]
+    by_name = {r.name: r for r in full}
+    assert by_name["live_devices"].op == "<"
+    assert by_name["hbm_live"].threshold == pytest.approx(0.9 * (1 << 30))
+
+
+def test_shed_fraction_signal_is_incremental(telemetry_capture):
+    tm = telemetry_capture
+    sig = alerts._shed_fraction_signal()
+    assert sig() is None                       # no traffic yet
+    tm.count("serve.submitted", n=10, endpoint="a")
+    tm.count("serve.shed", n=5, endpoint="a")
+    assert sig() == pytest.approx(0.5)
+    # next interval: clean traffic -> the fraction RESETS (not the
+    # process-lifetime average, which would never clear)
+    tm.count("serve.submitted", n=10, endpoint="a")
+    assert sig() == pytest.approx(0.0)
+    assert sig() is None                       # and quiet again
+
+
+# ---------------------------------------------------------------------------
+# the health sampler
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_env_gated_and_idempotent(telemetry_capture, monkeypatch):
+    monkeypatch.delenv(alerts.SAMPLE_ENV, raising=False)
+    assert alerts.start_sampler() is False     # no env, no interval
+    monkeypatch.setenv(alerts.SAMPLE_ENV, "not-a-number")
+    assert alerts.start_sampler() is False
+    monkeypatch.setenv(alerts.SAMPLE_ENV, "0.05")
+    assert alerts.start_sampler() is True
+    assert alerts.sampler_running()
+    assert alerts.start_sampler() is True      # idempotent join
+    alerts.stop_sampler()
+    assert not alerts.sampler_running()
+
+
+def test_sampler_tick_snapshots_health(telemetry_capture):
+    tm = telemetry_capture
+    tm.set_gauge("serve.queue_depth", 3.0)
+    s = alerts._HealthSampler(0.1, alerts.AlertManager())
+    s._tick()
+    samples = list(tm.events("sample"))
+    health = [e for e in samples if e["name"] == "health"]
+    assert len(health) == 1
+    assert health[0]["queue_depth"] == 3.0
+    assert tm.gauge_value("health.hbm_live_bytes") is not None
+
+
+def test_sampler_disabled_telemetry_is_noop(monkeypatch):
+    monkeypatch.setenv(alerts.SAMPLE_ENV, "0.05")
+    core.disable()
+    try:
+        assert alerts.start_sampler() is False
+        assert not alerts.sampler_running()
+        # the evaluation entry point is one boolean check when disabled
+        assert alerts.AlertManager([_p99_rule()]).evaluate() == {}
+    finally:
+        core.enable()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_prom_multi_endpoint_slo_histograms(telemetry_capture):
+    tm = telemetry_capture
+    buckets = (0.01, 0.1, 1.0)
+    for dt in (0.005, 0.05, 0.5):
+        tm.observe("serve.slo.request_s", dt, buckets=buckets,
+                   endpoint="chat")
+    tm.observe("serve.slo.request_s", 5.0, buckets=buckets,
+               endpoint="embed")
+    text = export.to_prometheus(tm.report())
+    # per-endpoint cumulative le series under ONE histogram family
+    assert text.count("# TYPE da_tpu_serve_slo_request_s histogram") == 1
+    assert 'da_tpu_serve_slo_request_s_bucket{endpoint="chat",le="0.01"} 1' \
+        in text
+    assert 'da_tpu_serve_slo_request_s_bucket{endpoint="chat",le="0.1"} 2' \
+        in text
+    assert 'da_tpu_serve_slo_request_s_bucket{endpoint="chat",le="1"} 3' \
+        in text
+    assert 'da_tpu_serve_slo_request_s_bucket{endpoint="chat",le="+Inf"} 3' \
+        in text
+    # the other endpoint's overflow lands only in +Inf
+    assert 'da_tpu_serve_slo_request_s_bucket{endpoint="embed",le="1"} 0' \
+        in text
+    assert 'da_tpu_serve_slo_request_s_bucket{endpoint="embed",le="+Inf"} 1' \
+        in text
+    assert 'da_tpu_serve_slo_request_s_count{endpoint="chat"} 3' in text
+
+
+def test_prom_label_escaping(telemetry_capture):
+    tm = telemetry_capture
+    tm.count("fallback.keys", key='say "hi"\\now', site="a\nb")
+    text = export.to_prometheus(tm.report())
+    line = next(l for l in text.splitlines()
+                if l.startswith("da_tpu_fallback_keys_total{"))
+    assert r'key="say \"hi\"\\now"' in line
+    assert r'site="a\nb"' in line
+    # still one sample, value intact
+    assert line.endswith(" 1")
+
+
+def test_prom_alert_active_gauge_family(telemetry_capture):
+    tm = telemetry_capture
+    mgr = alerts.AlertManager([_p99_rule()])
+    tm.set_gauge("serve.request_p99_s", 2.0)
+    mgr.evaluate(now=10.0)
+    text = export.to_prometheus(tm.report())
+    assert "# TYPE da_tpu_alert_active gauge" in text
+    assert 'da_tpu_alert_active{alert="serve_p99"} 1' in text
+    assert 'da_tpu_alerts_transitions_total{alert="serve_p99",' \
+           'state="firing"} 1' in text
+    tm.set_gauge("serve.request_p99_s", 0.01)
+    mgr.evaluate(now=11.5)
+    text = export.to_prometheus(tm.report())
+    assert 'da_tpu_alert_active{alert="serve_p99"} 0' in text
+
+
+def test_prom_exposition_parses_as_families(telemetry_capture):
+    """Every emitted line is either a comment or `name{labels} value` —
+    a scrape-shaped smoke over the whole registry with alerts, SLO
+    buckets and escaped labels all present at once."""
+    tm = telemetry_capture
+    tm.observe("serve.slo.request_s", 0.02, buckets=(0.01, 0.1),
+               endpoint='we"ird')
+    mgr = alerts.AlertManager([_p99_rule()])
+    tm.set_gauge("serve.request_p99_s", 2.0)
+    mgr.evaluate(now=1.0)
+    for line in export.to_prometheus(tm.report()).splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        assert name.startswith("da_tpu_"), line
+        float(line.rsplit(" ", 1)[1])          # value parses

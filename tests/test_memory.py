@@ -568,16 +568,21 @@ def test_cli_rc2_on_missing_empty_capped(telemetry_capture, tmp_path,
     rc = _cli([str(empty)])                        # legacy bare form
     assert rc == 2
     capsys.readouterr()
-    # cap-truncated: the journal.capped latch is printed, rc 2
-    monkeypatch.setenv("DA_TPU_TELEMETRY_JOURNAL_MAX_MB", "0.001")
+    # cap-truncated LEGACY latch (current writers rotate instead; an
+    # older writer — or one whose rotation os.replace failed — leaves a
+    # journal.capped marker): the latch is printed, rc 2
     capped = tmp_path / "capped.jsonl"
-    tm.configure(str(capped))
-    for i in range(200):
-        tm.event("filler", "e", i=i, payload="x" * 64)
+    capped.write_text(
+        json.dumps({"seq": 0, "t": 0.1, "cat": "filler", "name": "e"})
+        + "\n"
+        + json.dumps({"seq": 1, "t": 0.2, "cat": "journal",
+                      "name": "capped", "bytes_written": 1024,
+                      "max_bytes": 1024}) + "\n")
     rc = _cli(["summarize", str(capped)])
     err = capsys.readouterr().err
     assert rc == 2
     assert "cap-truncated" in err and "journal.capped" in err
+    assert "rotate" in err                    # points at the new behavior
     # prom and mem must refuse the truncated journal too — a dashboard
     # (or ledger view) fed under-counted totals is worse than none
     for sub in ("prom", "mem"):
