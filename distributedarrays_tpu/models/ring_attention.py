@@ -44,6 +44,7 @@ from ..parallel.collectives import (axis_size as _axis_size,
                                     shard_map_compat)
 
 __all__ = ["ring_attention", "ring_attention_kernel",
+           "ring_attention_prefill",
            "ring_attention_rdma_kernel",
            "ring_flash_attention", "ring_flash_attention_kernel",
            "zigzag_ring_attention", "zigzag_ring_attention_kernel",
@@ -335,6 +336,54 @@ def ring_attention(q: DArray, k: DArray, v: DArray,
             out = _ring_jit(L.mesh_for(pids, (n, 1, 1)), causal)(
                 q.garray, k.garray, v.garray)
         return _wrap_global(out, procs=pids, dist=[n, 1, 1])
+
+
+def ring_attention_prefill(q, k, v, *, causal: bool = True,
+                           procs: list[int] | None = None,
+                           min_ring_tokens: int | None = None):
+    """Cache-aware prefill entry for the decode service: exact causal
+    attention over host/device ``(ntok, heads, head_dim)`` q/k/v rows,
+    returning a host ``(ntok, heads, head_dim)`` output.
+
+    Long prompts ride the sequence-sharded ring kernel (RDMA when
+    armed): the rows are end-padded with zero rows to a multiple of the
+    rank count — safe under causal masking, since every real query row
+    sits *before* the padded key rows and never attends to them — then
+    distributed, run through :func:`ring_attention`, gathered, and
+    trimmed, with the scratch DArrays closed before returning (the
+    caller's HBM ledger only keeps the KV pages it writes back).  Short
+    prompts (below ``min_ring_tokens``, default ``2 * nranks``) take the
+    dense :func:`reference_attention` oracle — sharding a handful of
+    rows buys nothing and the grid would not divide."""
+    q = np.asarray(q, np.float32)
+    k = np.asarray(k, np.float32)
+    v = np.asarray(v, np.float32)
+    if q.ndim != 3:
+        raise ValueError(f"q must be (ntok, heads, head_dim), "
+                         f"got {q.shape}")
+    ntok = q.shape[0]
+    pids = [int(p) for p in (procs if procs is not None
+                             else L.all_ranks())]
+    n = max(1, len(pids))
+    floor = 2 * n if min_ring_tokens is None else int(min_ring_tokens)
+    if not causal or n < 2 or ntok < max(floor, n):
+        return reference_attention(q, k, v, causal)
+    from ..darray import distribute
+    pad = (-ntok) % n
+    if pad:
+        z = np.zeros((pad,) + q.shape[1:], q.dtype)
+        q, k, v = (np.concatenate([a, z]) for a in (q, k, v))
+    dq = dk = dv = dout = None
+    try:
+        dq = distribute(q, procs=pids, dist=[n, 1, 1])
+        dk = distribute(k, procs=pids, dist=[n, 1, 1])
+        dv = distribute(v, procs=pids, dist=[n, 1, 1])
+        dout = ring_attention(dq, dk, dv, causal=True)
+        return np.asarray(dout.garray)[:ntok]
+    finally:
+        for d in (dq, dk, dv, dout):
+            if d is not None:
+                d.close()
 
 
 def _ring_flash_fwd_loop(q, k, v, axis, causal, scale, block_q, block_k,

@@ -10,20 +10,36 @@ Quick use::
     y = fut.result()          # result, or a typed ServeError
     srv.close()               # graceful: stop admitting, flush, stop
 
+LLM decode (paged KV cache + continuous batching)::
+
+    eng = serve.DecodeEngine()            # toy model; pass your own
+    eng.attach(srv, "decode")             # server admission fronts it
+    stream = srv.submit("decode", [1, 2, 3]).result()
+    for tok in stream:                    # tokens as they land
+        ...
+
 Architecture, admission/shedding policy knobs, deadline semantics, and a
-worked overload walkthrough: docs/serving.md.
+worked overload walkthrough: docs/serving.md.  Asyncio front-end:
+``serve.aio``.
 """
 
+from . import aio
 from .admission import AdmissionController, LatencyWindow, TokenBucket
 from .batching import BatchQueue, Request, payload_key
-from .errors import (DeadlineExceeded, Draining, Overloaded, QuotaExceeded,
-                     Rejected, RequestFailed, ServeError)
+from .decode import (DecodeConfig, DecodeEngine, TinyLM, TokenStream,
+                     WeightedFairQueue)
+from .errors import (Cancelled, DeadlineExceeded, Draining, Overloaded,
+                     QuotaExceeded, Rejected, RequestFailed, ServeError)
+from .kvcache import KVCacheConfig, PagedKVCache
 from .server import Endpoint, ServeConfig, Server, install_sigterm
 
 __all__ = [
     "Server", "ServeConfig", "Endpoint", "install_sigterm",
     "AdmissionController", "LatencyWindow", "TokenBucket",
     "BatchQueue", "Request", "payload_key",
+    "KVCacheConfig", "PagedKVCache",
+    "DecodeConfig", "DecodeEngine", "TinyLM", "TokenStream",
+    "WeightedFairQueue", "aio",
     "ServeError", "Rejected", "Overloaded", "QuotaExceeded", "Draining",
-    "DeadlineExceeded", "RequestFailed",
+    "DeadlineExceeded", "Cancelled", "RequestFailed",
 ]

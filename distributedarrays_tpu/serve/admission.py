@@ -117,6 +117,8 @@ class AdmissionController:
                  hbm_shed_fraction: float = 0.9,
                  p99_shed_s: float | None = None,
                  max_batch: int = 8, window: int = 256,
+                 endpoint_windows: dict[str, int] | None = None,
+                 reclaimable_fn=None,
                  min_retry_after: float = 0.05,
                  max_retry_after: float = 5.0):
         self.max_queue = int(max_queue)
@@ -128,7 +130,13 @@ class AdmissionController:
         self.max_batch = int(max_batch)
         self.min_retry_after = float(min_retry_after)
         self.max_retry_after = float(max_retry_after)
+        self.window = int(window)
         self.latency = LatencyWindow(maxlen=window)
+        # bytes an HBM shed could reclaim right now (idle-evictable KV
+        # pages); set by the owner of reclaimable state (decode engine)
+        self.reclaimable_fn = reclaimable_fn
+        self._endpoint_windows = dict(endpoint_windows or {})
+        self._ep_latency: dict[str, LatencyWindow] = {}
         self._buckets: dict[str, TokenBucket] = {}
         self._bucket_overrides: dict[str, tuple[float, float]] = {}
         self._lock = threading.Lock()
@@ -155,6 +163,37 @@ class AdmissionController:
         read (no refill applied), for the server's per-tenant token
         gauge."""
         return self._bucket(tenant).level()
+
+    # -- per-endpoint latency windows --------------------------------------
+
+    def set_endpoint_window(self, endpoint: str, maxlen: int) -> None:
+        """Per-endpoint p99 window size override (a cheap endpoint with
+        high request rates wants a larger window than a heavy one —
+        otherwise the percentile flaps on a handful of samples).  Drops
+        any window already accumulated for ``endpoint``."""
+        with self._lock:
+            self._endpoint_windows[endpoint] = int(maxlen)
+            self._ep_latency.pop(endpoint, None)
+
+    def endpoint_latency(self, endpoint: str) -> LatencyWindow:
+        """The rolling window for ``endpoint``, created lazily at its
+        configured size (``endpoint_windows`` override, else the global
+        ``window``)."""
+        with self._lock:
+            w = self._ep_latency.get(endpoint)
+            if w is None:
+                size = self._endpoint_windows.get(endpoint, self.window)
+                w = self._ep_latency[endpoint] = LatencyWindow(maxlen=size)
+            return w
+
+    def record_latency(self, seconds: float,
+                       endpoint: str | None = None) -> None:
+        """Record one dispatch latency into the global window (the shed
+        signal) and, when named, the endpoint's own window (the
+        per-endpoint p99 gauge)."""
+        self.latency.record(seconds)
+        if endpoint is not None:
+            self.endpoint_latency(endpoint).record(seconds)
 
     # -- retry_after estimation --------------------------------------------
 
@@ -192,12 +231,27 @@ class AdmissionController:
             live = _tm.memory.live_bytes()
             bound = self.hbm_shed_fraction * self.hbm_budget_bytes
             if live >= bound:
-                ra = self.drain_estimate(max(queue_depth, 1))
+                # retry_after must not over-estimate when the pressure
+                # is reclaimable: idle-evictable KV pages free at the
+                # next eviction sweep, not at queue-drain pace
+                reclaim = 0
+                if self.reclaimable_fn is not None:
+                    try:
+                        reclaim = int(self.reclaimable_fn())
+                    except Exception:   # noqa: BLE001 — advisory signal
+                        reclaim = 0
+                if live - reclaim < bound:
+                    ra = self.min_retry_after
+                else:
+                    ra = self.drain_estimate(max(queue_depth, 1))
                 _tm.count("serve.shed", reason="hbm", tenant=tenant)
                 raise Overloaded(
                     f"HBM live bytes {live} over "
                     f"{self.hbm_shed_fraction:.0%} of budget "
-                    f"{self.hbm_budget_bytes}; retry in {ra:.3f}s",
+                    f"{self.hbm_budget_bytes}"
+                    + (f" ({reclaim} reclaimable by eviction)"
+                       if reclaim else "")
+                    + f"; retry in {ra:.3f}s",
                     retry_after=ra, reason="hbm", tenant=tenant)
         if self.p99_shed_s is not None and self.latency.count() >= 8:
             p99 = self.latency.p99()

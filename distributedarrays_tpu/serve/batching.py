@@ -39,6 +39,13 @@ def payload_key(payload: Any) -> Any:
     mixed-shape payloads into one device program would retrace per
     batch instead of reusing one compilation."""
     if hasattr(payload, "shape") and hasattr(payload, "dtype"):
+        if str(payload.dtype) == "object":
+            # ragged container: (shape, dtype) says nothing about the
+            # elements — two object arrays holding different-length
+            # prompts must NOT coalesce (stacking them crashes at
+            # dispatch); key elementwise like a sequence
+            return ("array_obj", tuple(payload.shape),
+                    tuple(payload_key(p) for p in np.asarray(payload).flat))
         return ("array", tuple(payload.shape), str(payload.dtype))
     if isinstance(payload, (tuple, list)):
         return ("seq", type(payload).__name__,

@@ -16,6 +16,8 @@ Hierarchy::
     │   │   └── QuotaExceeded  (per-tenant token bucket; retry_after)
     │   └── Draining        (server is shutting down; do not retry here)
     ├── DeadlineExceeded    (budget expired at enqueue/batch/dispatch)
+    ├── Cancelled           (client cancelled a streaming sequence;
+    │                        its pages were freed immediately)
     └── RequestFailed       (dispatch failed after recovery gave up;
                              __cause__ carries the root failure)
 """
@@ -23,7 +25,7 @@ Hierarchy::
 from __future__ import annotations
 
 __all__ = ["ServeError", "Rejected", "Overloaded", "QuotaExceeded",
-           "Draining", "DeadlineExceeded", "RequestFailed"]
+           "Draining", "DeadlineExceeded", "Cancelled", "RequestFailed"]
 
 
 class ServeError(RuntimeError):
@@ -85,6 +87,12 @@ class DeadlineExceeded(ServeError):
     def __init__(self, message: str, *, stage: str = "enqueue"):
         super().__init__(message)
         self.stage = stage
+
+
+class Cancelled(ServeError):
+    """The client cancelled a streaming sequence (``TokenStream.cancel``).
+    Cancellation is immediate on the resource side — the sequence's KV
+    pages return to the pool before this surfaces to any waiter."""
 
 
 class RequestFailed(ServeError):

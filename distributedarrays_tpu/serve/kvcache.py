@@ -1,0 +1,422 @@
+"""Paged KV-cache: per-sequence attention state as resident DArray pages.
+
+The decode service's working set is the KV cache — per-sequence key/value
+rows that grow one token at a time and dominate HBM at scale.  This
+module holds that state the way the rest of the stack holds model
+state: as *registered sharded DArrays*, so every byte is visible to the
+PR 5 HBM ledger (owner ``serve.kv`` via the allocation span) and every
+page is re-laid onto survivors by ``resilience.elastic`` on shrink/grow
+exactly like model parameters.
+
+Layout (vLLM-style paging, re-derived for DArrays):
+
+- Storage is a pool of **page blocks** — DArrays of shape
+  ``(block_pages, 2, page_tokens, heads, head_dim)`` (dim 1 = K/V),
+  sharded over the page dim so each page lives whole on one device.
+  Blocks are allocated on demand and **reaped** (closed) when fully
+  free, so the ledger's live-byte gauge tracks real cache usage and the
+  admission layer's live-bytes-vs-budget signal stays honest.
+- A **page** is ``page_tokens`` rows of K and V for one sequence; a
+  sequence owns an ordered page table (list of ``(block, slot)`` ids).
+  Writes land via incremental region mutation (one device's chunk);
+  reads gather the sequence's pages into contiguous ``(ntok, h, d)``
+  K/V arrays for the attention step.
+- **Backpressure**: allocation first evicts idle (unpinned,
+  least-recently-used) sequences when the pool or the HBM budget is
+  short; if eviction cannot cover the request the caller gets a typed
+  :class:`~.errors.Overloaded` (``reason="kv"``) with a honest
+  ``retry_after``.  Eviction frees the sequence's pages but keeps its
+  identity — the engine re-prefills it (K/V are a pure function of the
+  token history, so the rebuild is bit-identical).
+- ``idle_evictable_bytes()`` is the admission controller's *reclaimable*
+  signal: bytes a shed could free right now by evicting idle sequences
+  (so ``retry_after`` does not over-estimate when eviction can clear
+  budget immediately).
+
+Telemetry: ``serve.kv.pages_live/pages_free/seqs/bytes`` gauges,
+``serve.kv.evictions/blocks_created/blocks_reaped/sheds`` counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import telemetry as _tm
+from ..darray import dzeros
+from ..resilience import elastic
+from .errors import Overloaded, Rejected, ServeError
+
+__all__ = ["KVCacheConfig", "PagedKVCache"]
+
+
+@dataclasses.dataclass
+class KVCacheConfig:
+    """Paged-cache knobs.  ``hbm_budget_bytes`` bounds the *whole*
+    ledger (weights + cache + payloads), matching the admission gate's
+    signal; ``None`` disables budget-driven eviction (pool-size pressure
+    still applies)."""
+
+    page_tokens: int = 16             # K/V rows per page
+    heads: int = 4
+    head_dim: int = 8
+    dtype: Any = jnp.float32
+    max_pages: int = 256              # hard pool bound (all blocks)
+    block_pages: int = 8              # pages per DArray block (alloc granule)
+    hbm_budget_bytes: int | None = None
+    hbm_evict_fraction: float = 0.9   # evict when live >= fraction * budget
+    retry_after_s: float = 0.05       # shipped when eviction cannot cover
+
+
+@dataclasses.dataclass
+class _Block:
+    """One pool DArray: ``block_pages`` pages, sharded over the page
+    dim.  ``free`` is the set of unused slot indices."""
+
+    d: Any
+    free: set[int]
+
+
+@dataclasses.dataclass
+class _Seq:
+    """Per-sequence cache record: the page table plus the LRU/pin state
+    the eviction policy reads."""
+
+    seq_id: int
+    tenant: str
+    pages: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    ntok: int = 0                     # K/V rows written so far
+    last_use: float = 0.0
+    pinned: bool = False              # in-flight dispatch: never evicted
+
+
+class PagedKVCache:
+    """Fixed-size KV pages as resident sharded DArray state.
+
+    Thread-safe; never calls out of module under its lock (eviction
+    returns the evicted sequence ids to the caller instead of invoking
+    callbacks, so the engine's lock order stays engine -> cache)."""
+
+    def __init__(self, config: KVCacheConfig | None = None):
+        self.config = config or KVCacheConfig()
+        c = self.config
+        if c.page_tokens <= 0 or c.block_pages <= 0 or c.max_pages <= 0:
+            raise ValueError("page_tokens, block_pages and max_pages must "
+                             "be positive")
+        self._blocks: dict[int, _Block] = {}
+        self._next_block = 0
+        self._free: list[tuple[int, int]] = []   # (block_id, slot)
+        self._seqs: dict[int, _Seq] = {}
+        self._lock = threading.RLock()
+        self._closed = False
+        self.evictions = 0
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def page_nbytes(self) -> int:
+        c = self.config
+        item = jnp.dtype(c.dtype).itemsize
+        return 2 * c.page_tokens * c.heads * c.head_dim * item
+
+    def pages_for(self, ntok: int) -> int:
+        """Pages needed to hold ``ntok`` K/V rows."""
+        return max(1, -(-int(ntok) // self.config.page_tokens))
+
+    def capacity_pages(self) -> int:
+        return self.config.max_pages
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(len(s.pages) for s in self._seqs.values())
+            return {
+                "seqs": len(self._seqs),
+                "pages_live": live,
+                "pages_free": len(self._free),
+                "blocks": len(self._blocks),
+                "bytes_live": live * self.page_nbytes,
+                "evictions": self.evictions,
+            }
+
+    def live_bytes(self) -> int:
+        """Nominal bytes held by allocated pages (the ledger's view also
+        counts block padding; this is the policy-side number)."""
+        with self._lock:
+            return sum(len(s.pages) for s in self._seqs.values()) \
+                * self.page_nbytes
+
+    def idle_evictable_bytes(self) -> int:
+        """Bytes a shed could reclaim *right now*: pages of idle
+        (unpinned) sequences plus fully-free blocks awaiting reap.  The
+        admission controller's ``reclaimable_fn`` — a cache-full server
+        whose budget eviction can clear must not ship a drain-rate
+        ``retry_after``."""
+        with self._lock:
+            pages = sum(len(s.pages) for s in self._seqs.values()
+                        if not s.pinned)
+            free_block_pages = sum(
+                len(b.free) for b in self._blocks.values()
+                if len(b.free) == self.config.block_pages)
+            return (pages + free_block_pages) * self.page_nbytes
+
+    # -- gauges ------------------------------------------------------------
+
+    def _gauges_locked(self) -> None:
+        if not _tm.enabled():
+            return
+        live = sum(len(s.pages) for s in self._seqs.values())
+        _tm.set_gauge("serve.kv.pages_live", live)
+        _tm.set_gauge("serve.kv.pages_free", len(self._free))
+        _tm.set_gauge("serve.kv.seqs", len(self._seqs))
+        _tm.set_gauge("serve.kv.bytes", live * self.page_nbytes)
+
+    # -- pool management ---------------------------------------------------
+
+    def _grow_locked(self) -> bool:
+        """Allocate one more page block if the pool bound and the HBM
+        budget allow.  The DArray is created inside a ``serve.kv`` span
+        so the ledger attributes its bytes to the cache owner."""
+        c = self.config
+        total = len(self._blocks) * c.block_pages
+        if total + c.block_pages > c.max_pages:
+            return False
+        if c.hbm_budget_bytes is not None:
+            block_bytes = c.block_pages * self.page_nbytes
+            bound = c.hbm_evict_fraction * c.hbm_budget_bytes
+            if _tm.memory.live_bytes() + block_bytes > bound:
+                return False
+        ranks = elastic.manager().live_ranks()
+        n = max(1, min(len(ranks), c.block_pages))
+        with _tm.span("serve.kv", op="alloc_block",
+                      pages=c.block_pages):
+            d = dzeros((c.block_pages, 2, c.page_tokens, c.heads,
+                        c.head_dim), dtype=c.dtype,
+                       procs=ranks[:n], dist=[n, 1, 1, 1, 1])
+        bid = self._next_block
+        self._next_block += 1
+        self._blocks[bid] = _Block(d=d, free=set(range(c.block_pages)))
+        self._free.extend((bid, s) for s in range(c.block_pages))
+        _tm.count("serve.kv.blocks_created")
+        return True
+
+    def _reap_locked(self) -> None:
+        """Close fully-free blocks so the ledger drains with usage."""
+        for bid in [b for b, blk in self._blocks.items()
+                    if len(blk.free) == self.config.block_pages]:
+            blk = self._blocks.pop(bid)
+            self._free = [(b, s) for (b, s) in self._free if b != bid]
+            blk.d.close()
+            _tm.count("serve.kv.blocks_reaped")
+
+    def _budget_pressure_locked(self) -> bool:
+        c = self.config
+        if c.hbm_budget_bytes is None:
+            return False
+        return _tm.memory.live_bytes() >= \
+            c.hbm_evict_fraction * c.hbm_budget_bytes
+
+    def _evict_lru_locked(self) -> int | None:
+        """Evict the least-recently-used unpinned sequence; returns its
+        id (pages freed, record dropped) or None when nothing is
+        evictable."""
+        victims = [s for s in self._seqs.values()
+                   if not s.pinned and s.pages]
+        if not victims:
+            return None
+        v = min(victims, key=lambda s: s.last_use)
+        for bid, slot in v.pages:
+            blk = self._blocks.get(bid)
+            if blk is not None:
+                blk.free.add(slot)
+                self._free.append((bid, slot))
+        del self._seqs[v.seq_id]
+        self.evictions += 1
+        _tm.count("serve.kv.evictions", tenant=v.tenant)
+        return v.seq_id
+
+    def maybe_evict(self) -> list[int]:
+        """Budget-driven eviction sweep: while the ledger sits over the
+        eviction fraction of the budget, evict idle sequences LRU-first
+        and reap freed blocks.  Returns the evicted sequence ids (the
+        engine re-queues them for re-prefill)."""
+        evicted: list[int] = []
+        with self._lock:
+            while self._budget_pressure_locked():
+                sid = self._evict_lru_locked()
+                if sid is None:
+                    break
+                evicted.append(sid)
+                self._reap_locked()
+            if evicted:
+                self._gauges_locked()
+        return evicted
+
+    # -- sequence lifecycle ------------------------------------------------
+
+    def ensure(self, seq_id: int, ntok: int, *,
+               tenant: str = "default") -> list[int]:
+        """Grow ``seq_id``'s page table to cover ``ntok`` rows,
+        allocating (and evicting idle sequences, LRU-first) as needed.
+        Returns the ids of sequences evicted to make room.  Raises
+        :class:`Overloaded` (``reason="kv"``) when the demand cannot be
+        covered even after evicting every idle sequence."""
+        if self.pages_for(ntok) > self.config.max_pages:
+            # permanent: no amount of eviction covers this — reject
+            # before evicting innocents
+            raise Rejected(
+                f"sequence needs {self.pages_for(ntok)} pages; the pool "
+                f"holds {self.config.max_pages} at its hard bound",
+                reason="kv", tenant=tenant)
+        with self._lock:
+            if self._closed:
+                raise ServeError("kv cache is closed")
+            seq = self._seqs.get(seq_id)
+            if seq is None:
+                seq = self._seqs[seq_id] = _Seq(seq_id=seq_id,
+                                                tenant=tenant)
+            seq.last_use = time.monotonic()
+            need = self.pages_for(ntok) - len(seq.pages)
+            if need <= 0:
+                return []
+            evicted: list[int] = []
+            was_pinned = seq.pinned
+            seq.pinned = True      # never LRU-evict the seq being grown
+            try:
+                while len(self._free) < need:
+                    if self._grow_locked():
+                        continue
+                    sid = self._evict_lru_locked()
+                    if sid is None:
+                        _tm.count("serve.kv.sheds", tenant=tenant)
+                        self._gauges_locked()
+                        raise Overloaded(
+                            f"kv cache exhausted: need {need} pages for "
+                            f"seq {seq_id}, {len(self._free)} free of "
+                            f"{self.config.max_pages} max; retry in "
+                            f"{self.config.retry_after_s:.3f}s",
+                            retry_after=self.config.retry_after_s,
+                            reason="kv", tenant=tenant)
+                    evicted.append(sid)
+            finally:
+                seq.pinned = was_pinned
+            for _ in range(need):
+                bid, slot = self._free.pop()
+                self._blocks[bid].free.discard(slot)
+                seq.pages.append((bid, slot))
+            self._gauges_locked()
+            return evicted
+
+    def write(self, seq_id: int, start: int, k, v) -> None:
+        """Write K/V rows for tokens ``[start, start + n)`` of
+        ``seq_id`` (``k``/``v``: ``(n, heads, head_dim)``).  Pages must
+        already be ensured; writes are incremental region mutations so
+        only the owning device's chunk is touched per page."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        n = k.shape[0]
+        pt = self.config.page_tokens
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is None:
+                raise ServeError(f"unknown kv sequence {seq_id}")
+            if self.pages_for(start + n) > len(seq.pages):
+                raise ServeError(
+                    f"seq {seq_id}: write [{start}, {start + n}) exceeds "
+                    f"{len(seq.pages)} ensured pages")
+            off = 0
+            while off < n:
+                tok = start + off
+                page, po = divmod(tok, pt)
+                take = min(n - off, pt - po)
+                bid, slot = seq.pages[page]
+                d = self._blocks[bid].d
+                d[slot, 0, po:po + take] = k[off:off + take]
+                d[slot, 1, po:po + take] = v[off:off + take]
+                off += take
+            seq.ntok = max(seq.ntok, start + n)
+            seq.last_use = time.monotonic()
+
+    def read(self, seq_id: int):
+        """Gather ``seq_id``'s resident K/V as ``(ntok, heads,
+        head_dim)`` arrays (the decode step's contiguous view)."""
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is None:
+                raise ServeError(f"unknown kv sequence {seq_id}")
+            ks, vs = [], []
+            for bid, slot in seq.pages:
+                g = self._blocks[bid].d.garray
+                ks.append(g[slot, 0])
+                vs.append(g[slot, 1])
+            seq.last_use = time.monotonic()
+            ntok = seq.ntok
+        k = jnp.concatenate(ks)[:ntok]
+        v = jnp.concatenate(vs)[:ntok]
+        return k, v
+
+    def ntok(self, seq_id: int) -> int:
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            return 0 if seq is None else seq.ntok
+
+    def has(self, seq_id: int) -> bool:
+        with self._lock:
+            return seq_id in self._seqs
+
+    def pin(self, seq_id: int) -> None:
+        """Exclude ``seq_id`` from eviction (in-flight dispatch)."""
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is not None:
+                seq.pinned = True
+
+    def unpin(self, seq_id: int) -> None:
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is not None:
+                seq.pinned = False
+                seq.last_use = time.monotonic()
+
+    def release(self, seq_id: int) -> None:
+        """Free ``seq_id``'s pages (completion or cancellation) and reap
+        any block the release fully emptied — cancellation must return
+        HBM immediately, not at the next sweep."""
+        with self._lock:
+            seq = self._seqs.pop(seq_id, None)
+            if seq is None:
+                return
+            for bid, slot in seq.pages:
+                blk = self._blocks.get(bid)
+                if blk is not None:
+                    blk.free.add(slot)
+                    self._free.append((bid, slot))
+            self._reap_locked()
+            self._gauges_locked()
+
+    def close(self) -> None:
+        """Release every sequence and close every block DArray (drains
+        the ledger to zero for this owner)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._seqs.clear()
+            self._free.clear()
+            for blk in self._blocks.values():
+                blk.d.close()
+            self._blocks.clear()
+            self._gauges_locked()
+
+    def __enter__(self) -> "PagedKVCache":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -79,6 +79,10 @@ class ServeConfig:
     hbm_shed_fraction: float = 0.9
     p99_shed_s: float | None = None
     latency_window: int = 256
+    # per-endpoint p99 window-size overrides ({endpoint: maxlen}); an
+    # endpoint absent here uses latency_window (register(...,
+    # latency_window=) overrides both)
+    endpoint_latency_windows: dict[str, int] | None = None
     workers: int = 1                  # dispatch loop threads
     drain_timeout_s: float = 30.0
 
@@ -126,7 +130,8 @@ class Server:
             hbm_shed_fraction=self.config.hbm_shed_fraction,
             p99_shed_s=self.config.p99_shed_s,
             max_batch=self.config.max_batch,
-            window=self.config.latency_window)
+            window=self.config.latency_window,
+            endpoint_windows=self.config.endpoint_latency_windows)
         self._queue = BatchQueue()
         self._endpoints: dict[str, Endpoint] = {}
         self._policy = policy
@@ -152,9 +157,13 @@ class Server:
 
     def register(self, name: str, fn: Callable[[list], list], *,
                  max_batch: int | None = None, flush_s: float | None = None,
-                 key_fn: Callable[[Any], Any] | None = None) -> Endpoint:
+                 key_fn: Callable[[Any], Any] | None = None,
+                 latency_window: int | None = None) -> Endpoint:
         """Register a batched endpoint.  ``fn`` takes the list of
-        coalesced payloads and returns one result per payload."""
+        coalesced payloads and returns one result per payload.
+        ``latency_window`` overrides the endpoint's rolling-p99 window
+        size (else ``ServeConfig.endpoint_latency_windows``, else the
+        global ``latency_window``)."""
         ep = Endpoint(
             name=name, fn=fn,
             max_batch=int(max_batch if max_batch is not None
@@ -162,6 +171,8 @@ class Server:
             flush_s=float(flush_s if flush_s is not None
                           else self.config.flush_s),
             key_fn=key_fn or payload_key)
+        if latency_window is not None:
+            self._admission.set_endpoint_window(name, latency_window)
         with self._lock:
             if self._closed:
                 raise ServeError("server is closed")
@@ -170,6 +181,13 @@ class Server:
 
     def set_quota(self, tenant: str, rate: float, burst: float) -> None:
         self._admission.set_quota(tenant, rate, burst)
+
+    def set_reclaimable(self, fn: Callable[[], int] | None) -> None:
+        """Wire a reclaimable-bytes signal (e.g. the decode engine's
+        ``PagedKVCache.idle_evictable_bytes``) into admission: an HBM
+        shed whose pressure eviction can clear ships the clamp-floor
+        ``retry_after`` instead of the queue drain estimate."""
+        self._admission.reclaimable_fn = fn
 
     # -- submission --------------------------------------------------------
 
@@ -316,12 +334,20 @@ class Server:
         with _tm.trace_ctx(*(r.trace_id for r in live)):
             self._dispatch_traced(ep, live)
 
-    def _record_latency(self, dt: float) -> None:
-        self._admission.latency.record(dt)
+    def _record_latency(self, dt: float,
+                        endpoint: str | None = None) -> None:
+        self._admission.record_latency(dt, endpoint)
         # rolling p99 as a gauge: the alerts module's serve_p99 burn-rate
         # rule (and any scraper) samples it without reaching into the
-        # admission controller
+        # admission controller.  The unlabeled gauge is the global shed
+        # signal; the labeled one is the per-endpoint window (its own
+        # maxlen per ServeConfig/register)
         _tm.set_gauge("serve.request_p99_s", self._admission.latency.p99())
+        if endpoint is not None:
+            _tm.set_gauge(
+                "serve.request_p99_s",
+                self._admission.endpoint_latency(endpoint).p99(),
+                endpoint=endpoint)
 
     def _dispatch_traced(self, ep: Endpoint, live: list[Request]) -> None:
         payloads = [r.payload for r in live]
@@ -348,7 +374,7 @@ class Server:
             # fail the batch Draining — the client-visible story is
             # "server going away", not a generic dispatch failure
             dt = time.monotonic() - t0
-            self._record_latency(dt)
+            self._record_latency(dt, ep.name)
             with self._lock:
                 self._draining = True
             self._drain_wake.set()
@@ -368,7 +394,7 @@ class Server:
             return
         except Exception as e:  # noqa: BLE001 — typed and shipped to futures
             dt = time.monotonic() - t0
-            self._record_latency(dt)
+            self._record_latency(dt, ep.name)
             err = e if isinstance(e, ServeError) else RequestFailed(
                 f"batch dispatch failed after recovery gave up "
                 f"(endpoint={ep.name}, size={len(live)}): "
@@ -380,7 +406,7 @@ class Server:
                 r.fail(err)
             return
         dt = time.monotonic() - t0
-        self._record_latency(dt)
+        self._record_latency(dt, ep.name)
         _tm.observe("serve.batch_latency_s", dt, endpoint=ep.name)
         _tm.observe("serve.batch_size", len(live), endpoint=ep.name)
         if not isinstance(results, (list, tuple)) or \

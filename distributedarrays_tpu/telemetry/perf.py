@@ -37,6 +37,7 @@ __all__ = [
     "DEFAULT_PEAKS", "peaks_for", "platform_alias",
     "gemm_cost", "reshard_cost", "attention_cost", "reduce_cost",
     "transfer_cost", "train_step_cost",
+    "decode_step_cost",
     "span_cost", "classify_occurrence", "classify", "coverage",
     "overlap_stats", "interval_overlap", "timeline_overlap",
     "train_step_overlap", "critical_path", "analyze",
@@ -169,6 +170,26 @@ def attention_cost(s: int, h: int, d: int, itemsize: int = 4, *,
         "flops": fl,
         "bytes_hbm": 4 * int(s) * int(h) * int(d) * int(itemsize),
         "bytes_ici": (int(p) - 1) * kv if p > 1 else 0,
+    }
+
+
+def decode_step_cost(ctx_tokens: int, h: int, d: int,
+                     itemsize: int = 4, *, new_tokens: int = 1) -> dict:
+    """Stamp for one continuous-batching decode step: ``ctx_tokens``
+    total resident context rows across the batch attended by
+    ``new_tokens`` single-row queries.  Two row-by-context GEMVs per
+    head (``4·ctx·h·d`` flops) against the *entire* K/V working set
+    streamed from HBM once plus the new rows written back — arithmetic
+    intensity ~0.5 flop/byte at f32, firmly under any roofline ridge,
+    which is exactly why the doctor must show decode HBM-bound where
+    prefill (:func:`attention_cost`, O(s²) flops over O(s) bytes) shows
+    compute-bound."""
+    e = int(h) * int(d)
+    return {
+        "flops": 4 * int(ctx_tokens) * e,
+        "bytes_hbm": (2 * int(ctx_tokens) + 3 * int(new_tokens)) * e
+        * int(itemsize),
+        "bytes_ici": 0,
     }
 
 

@@ -768,3 +768,107 @@ def test_serving_metrics_and_spans_recorded():
     assert tm.counter_value("serve.batches", endpoint="echo") >= 1
     assert tm.gauge_value("serve.queue_depth") == 0
     assert "serve.dispatch" in tm.span_stats()
+
+
+# ---------------------------------------------------------------------------
+# ragged / streaming payload signatures (the decode service's traffic)
+# ---------------------------------------------------------------------------
+
+
+def test_payload_key_ragged_sequences_never_coalesce():
+    k = serve.payload_key
+    # variable-length prompts: lists of different lengths are distinct
+    assert k([1, 2]) != k([1, 2, 3])
+    assert k([1, 2]) == k([9, 9])
+    # object-dtype (ragged) arrays key elementwise, not by (shape, dtype)
+    a = np.empty(2, dtype=object)
+    a[0], a[1] = [1, 2], [3, 4, 5]
+    b = np.empty(2, dtype=object)
+    b[0], b[1] = [7, 8, 9], [1]
+    assert k(a) != k(b)               # different inner lengths
+    c = np.empty(2, dtype=object)
+    c[0], c[1] = [5, 6], [7, 8, 9]
+    assert k(a) == k(c)               # same ragged profile coalesces
+    assert k(a)[0] == "array_obj"
+    # streaming payloads (generators) key by type — opaque, one class
+    assert k(x for x in [1]) == k(x for x in [2, 3])
+
+
+def test_ragged_prompts_batch_safely_end_to_end():
+    """An endpoint that stacks its batch would crash on a mixed-length
+    batch; the key must keep every dispatched batch homogeneous."""
+    def ep(xs):
+        stacked = np.stack([np.asarray(x) for x in xs])   # throws if ragged
+        return [int(r.sum()) for r in stacked]
+
+    with serve.Server(_cfg(flush_s=0.02, max_batch=8)) as srv:
+        srv.register("sum", ep)
+        prompts = [[1] * (2 + i % 3) for i in range(12)]
+        futs = [srv.submit("sum", p) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=10) == sum(p)
+
+
+# ---------------------------------------------------------------------------
+# per-endpoint latency windows + eviction-aware HBM retry_after
+# ---------------------------------------------------------------------------
+
+
+def test_per_endpoint_latency_window_and_p99_gauge():
+    cfg = _cfg(endpoint_latency_windows={"fast": 4})
+    with serve.Server(cfg) as srv:
+        srv.register("fast", lambda xs: xs)
+        srv.register("slow", lambda xs: xs, latency_window=8)
+        for i in range(6):
+            assert srv.submit("fast", i).result(timeout=10) == i
+            assert srv.submit("slow", i).result(timeout=10) == i
+        adm = srv._admission
+        # ServeConfig map and register() override both take effect
+        assert adm.endpoint_latency("fast")._samples.maxlen == 4
+        assert adm.endpoint_latency("slow")._samples.maxlen == 8
+        assert adm.endpoint_latency("other")._samples.maxlen == \
+            adm.window                  # unconfigured: the global size
+        assert adm.endpoint_latency("fast").count() == 4   # window rolled
+    # the per-endpoint p99 gauge carries the endpoint label; the
+    # unlabeled gauge stays the global shed signal
+    assert tm.gauge_value("serve.request_p99_s", endpoint="fast") >= 0
+    assert tm.gauge_value("serve.request_p99_s", endpoint="slow") >= 0
+    assert tm.gauge_value("serve.request_p99_s") is not None
+
+
+def test_hbm_shed_retry_after_accounts_reclaimable(rng):
+    d = dat.distribute(rng.standard_normal((16, 16)))
+    try:
+        live = tmem.live_bytes()
+        assert live > 0
+
+        def _ctl(**kw):
+            c = serve.AdmissionController(
+                max_queue=64, tenant_rate=1e6, tenant_burst=1e6,
+                hbm_budget_bytes=live, hbm_shed_fraction=0.5,
+                max_batch=1, **kw)
+            for _ in range(8):
+                c.latency.record(2.0)   # slow drain: estimate >> floor
+            return c
+
+        # without a reclaimable signal the shed ships the drain estimate
+        slow = _ctl()
+        with pytest.raises(Overloaded) as e1:
+            slow.admit("t", queue_depth=2)
+        assert e1.value.reason == "hbm"
+        assert e1.value.retry_after > slow.min_retry_after
+        # with the pressure fully reclaimable (idle-evictable KV pages),
+        # the honest retry_after is the floor: eviction clears at the
+        # next sweep, not at queue-drain pace
+        fast = _ctl(reclaimable_fn=lambda: live)
+        with pytest.raises(Overloaded) as e2:
+            fast.admit("t", queue_depth=2)
+        assert e2.value.retry_after == fast.min_retry_after
+        assert "reclaimable by eviction" in str(e2.value)
+        # a broken reclaimable callback degrades to the conservative path
+        broken = _ctl(reclaimable_fn=lambda: 1 / 0)
+        with pytest.raises(Overloaded) as e3:
+            broken.admit("t", queue_depth=2)
+        assert e3.value.retry_after == e1.value.retry_after
+    finally:
+        dat.close(d)
