@@ -309,6 +309,32 @@ def _comm_bytes_now():
         return 0
 
 
+# partial-row banking (ROADMAP item 5): a config that measures several
+# metrics publishes the ones already complete through ``bank_partial``;
+# if the config then times out (or dies), _guarded banks the published
+# metrics with ``{label}_partial: true`` provenance instead of discarding
+# the whole row — a 20-minute silicon window that produced a real
+# iteration count keeps it even when the timing reps never finished.  A
+# later full success supersedes the partials (the flag clears with the
+# other stale markers), and a partial row does NOT count as banked, so
+# the next window re-attempts the full config.
+import threading as _threading
+
+_PARTIAL_LOCK = _threading.Lock()
+_PARTIAL: dict = {}
+
+
+def bank_partial(label, **metrics):
+    """Publish already-measured metrics from inside a running config."""
+    with _PARTIAL_LOCK:
+        _PARTIAL.setdefault(label, {}).update(metrics)
+
+
+def _take_partial(label):
+    with _PARTIAL_LOCK:
+        return _PARTIAL.pop(label, None)
+
+
 def _span_wrapped(label, fn, stats=None):
     """Run a config under a ``bench.config`` telemetry span so the
     journal's comm/span events are attributable per bench label.  The
@@ -383,6 +409,7 @@ BANKED_SENTINELS = {
     "gemm_f32_highest": "gemm_4096_f32_highest_gflops",
     "gemm_16k_1x1_f32_highest": "gemm_16k_1x1_f32_highest_gflops",
     "gemm_crosscheck": "gemm_4096_marginal_crosscheck_s",
+    "cg_poisson": "cg_poisson_time_s",
     "matmul_impl_tune": "matmul_impl_tune_n",
     "flash_attn": "flash_attn_8k_bf16_s_per_iter",
 }
@@ -399,7 +426,10 @@ def _banked_in(details, label):
         sent = label + ("_gflops" if label.endswith("_f32_highest")
                         else "_bf16pass_gflops")
     return (sent is not None and sent in details
-            and f"{label}_error" not in details)
+            and f"{label}_error" not in details
+            # a partial row holds real numbers but not the full config:
+            # the next hardware window must re-attempt it
+            and not details.get(f"{label}_partial"))
 
 
 def _guarded(details, label, fn, timeout_s=420.0):
@@ -433,8 +463,9 @@ def _guarded(details, label, fn, timeout_s=420.0):
     # whatever ends up in the table is attributable to this attempt.
     # Labels this invocation never reaches keep their markers on disk.
     for stale in (f"{label}_error", f"{label}_rerun_error",
-                  f"{label}_orphan_running"):
+                  f"{label}_orphan_running", f"{label}_partial"):
         details.pop(stale, None)
+    _take_partial(label)                 # drop any stale published metrics
     comm0 = _comm_bytes_now()
     worker_stats: dict = {}
     fn = _span_wrapped(label, fn, worker_stats)
@@ -453,6 +484,11 @@ def _guarded(details, label, fn, timeout_s=420.0):
     err_key = f"{label}_rerun_error" if banked else f"{label}_error"
     if not finished:
         details[err_key] = f"timed out after {effective:.0f}s"
+        partial = _take_partial(label)
+        if partial:
+            # bank what the config DID measure, flagged as partial
+            details.update(partial)
+            details[f"{label}_partial"] = True
         thread.join(60)
         if thread.is_alive():
             details[f"{label}_orphan_running"] = True
@@ -460,10 +496,15 @@ def _guarded(details, label, fn, timeout_s=420.0):
             _COMM_TAINTED = True
     elif isinstance(res, Exception):
         details[err_key] = f"{type(res).__name__}: {res}"
+        partial = _take_partial(label)
+        if partial:
+            details.update(partial)
+            details[f"{label}_partial"] = True
     elif res:
         details.update(res)
+        _take_partial(label)             # full row supersedes the partials
         for stale in (f"{label}_error", f"{label}_rerun_error",
-                      f"{label}_orphan_running"):
+                      f"{label}_orphan_running", f"{label}_partial"):
             details.pop(stale, None)
         # comms-bytes column: estimated bytes this config moved (telemetry
         # comm accounting delta over the config's whole run, retries
@@ -1984,6 +2025,54 @@ def main():
                 "sort_1e7_melem_per_s": 1e7 / t_sort / 1e6}
 
     _guarded(details, "sort", cfg_sort)
+
+    # ---- solver: CG time-to-tolerance on the 2-D Poisson system ----------
+    # the second hardware-meaningful number beyond GEMM: an HBM-bound
+    # iteration (5-point stencil matvec + BLAS-1 sweeps), reported as
+    # achieved GB/s against the spmv cost stamp.  Iteration count and
+    # final residual publish as partials the moment the first solve
+    # converges, so a timeout during the timing reps still banks them.
+    def cfg_cg_poisson():
+        from distributedarrays_tpu import solvers
+        from distributedarrays_tpu.telemetry import perf as _perf
+        NP = 1024
+        op = solvers.StencilOperator((NP, NP))
+        procs, pdist = op.vector_layout()
+        rhs = np.random.default_rng(7).standard_normal(
+            (NP, NP)).astype(np.float32)
+        b = dat.distribute(rhs, procs=procs, dist=list(pdist))
+        try:
+            def solve_once():
+                # iterations grow ~2.5*NP on this system (~2600 at 1024);
+                # the cap is headroom, not the expected count
+                r = solvers.cg(op, b, tol=1e-6, maxiter=6000)
+                r.x.close()
+                return r
+
+            res = solve_once()           # compile + correctness probe
+            bank_partial("cg_poisson",
+                         cg_poisson_iters=res.iterations,
+                         cg_poisson_residual=res.residual)
+            if not res.converged:
+                raise RuntimeError(
+                    f"cg outcome {res.outcome} after {res.iterations} iters")
+            t_solve = min(_t(solve_once) for _ in range(2))
+            # per-iteration HBM traffic: the stamped spmv volume plus ~10
+            # whole-vector passes of BLAS-1 (r/p/x/Ap reads and writes)
+            per_iter = (_perf.spmv_cost(5 * NP * NP, NP * NP, 4,
+                                        index_itemsize=0)["bytes_hbm"]
+                        + 10 * NP * NP * 4)
+            return {
+                "cg_poisson_iters": res.iterations,
+                "cg_poisson_residual": res.residual,
+                "cg_poisson_time_s": t_solve,
+                "cg_poisson_gbps":
+                    res.iterations * per_iter / t_solve / 1e9,
+            }
+        finally:
+            b.close()
+
+    _guarded(details, "cg_poisson", cfg_cg_poisson, timeout_s=600)
 
     # ---- last (riskiest): true-f32 GEMM (precision=HIGHEST) --------------
     # attempted after everything is banked, under a thread timeout: a

@@ -37,6 +37,7 @@ from .ops.sparse import dnnz, ddata_bcoo
 from . import parallel
 from . import resilience
 from . import serve
+from . import solvers
 from . import telemetry
 from . import train
 

@@ -1,0 +1,22 @@
+"""Distributed iterative solver suite (ROADMAP item 4).
+
+The classic DistributedArrays workload — iterative linear solvers over
+sharded operands — as a vertical slice through the stack: matrix-free
+operators whose ``apply`` is a compiled communication schedule
+(:mod:`.operators`), Krylov loops with typed outcomes and device-loss
+recovery (:mod:`.krylov`), a geometric multigrid preconditioner
+(:mod:`.multigrid`), and a streaming ``solve`` endpoint on the serving
+layer (:mod:`.service`).
+"""
+
+from .operators import (DenseOperator, LinearOperator, SparseOperator,
+                        StencilOperator, poisson2d_dense)
+from .krylov import SolveResult, bicgstab, cg, gmres
+from .multigrid import Multigrid
+from .service import SolverService, SolveStream
+
+__all__ = [
+    "LinearOperator", "DenseOperator", "SparseOperator", "StencilOperator",
+    "poisson2d_dense", "SolveResult", "cg", "bicgstab", "gmres",
+    "Multigrid", "SolverService", "SolveStream",
+]

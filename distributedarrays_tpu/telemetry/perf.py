@@ -37,7 +37,7 @@ __all__ = [
     "DEFAULT_PEAKS", "peaks_for", "platform_alias",
     "gemm_cost", "reshard_cost", "attention_cost", "reduce_cost",
     "transfer_cost", "train_step_cost",
-    "decode_step_cost",
+    "decode_step_cost", "spmv_cost",
     "span_cost", "classify_occurrence", "classify", "coverage",
     "overlap_stats", "interval_overlap", "timeline_overlap",
     "train_step_overlap", "critical_path", "analyze",
@@ -190,6 +190,24 @@ def decode_step_cost(ctx_tokens: int, h: int, d: int,
         "bytes_hbm": (2 * int(ctx_tokens) + 3 * int(new_tokens)) * e
         * int(itemsize),
         "bytes_ici": 0,
+    }
+
+
+def spmv_cost(nnz: int, rows: int, itemsize: int = 4, *,
+              index_itemsize: int = 4, bytes_ici: int = 0) -> dict:
+    """Stamp for a sparse (or stencil) matvec: 2 flops per stored
+    nonzero against the nonzeros (values + column indices) streamed
+    from HBM once, plus the vector read and result written back.
+    Arithmetic intensity ~0.25 flop/byte at f32+int32 — far under any
+    ridge point, so the doctor classifies SpMV HBM-bound, or ICI-bound
+    once the caller's halo exchange (``bytes_ici``) dominates.  Stencil
+    callers pass ``index_itemsize=0`` (the pattern compiles into the
+    kernel; only values move)."""
+    return {
+        "flops": 2 * int(nnz),
+        "bytes_hbm": int(nnz) * (int(itemsize) + int(index_itemsize))
+        + 2 * int(rows) * int(itemsize),
+        "bytes_ici": int(bytes_ici),
     }
 
 

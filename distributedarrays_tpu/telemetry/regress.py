@@ -41,7 +41,8 @@ __all__ = [
 ]
 
 _LOWER_BETTER = re.compile(
-    r"(_s|_s_per_iter|_seconds|_latency_s|_p50_s|_p99_s|_ms)$")
+    r"(_s|_s_per_iter|_seconds|_latency_s|_p50_s|_p99_s|_ms|"
+    r"_iters|_iterations|_residual)$")
 _HIGHER_BETTER = re.compile(
     r"(_gflops|_tflops|_gbps|_mfu|_tokens_per_s|_per_s|_rps|"
     r"gflops|tflops)$")

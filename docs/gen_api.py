@@ -77,6 +77,11 @@ MODULES = [
     "distributedarrays_tpu.serve.kvcache",
     "distributedarrays_tpu.serve.decode",
     "distributedarrays_tpu.serve.aio",
+    "distributedarrays_tpu.solvers",
+    "distributedarrays_tpu.solvers.operators",
+    "distributedarrays_tpu.solvers.krylov",
+    "distributedarrays_tpu.solvers.multigrid",
+    "distributedarrays_tpu.solvers.service",
     "distributedarrays_tpu.utils.checkpoint",
     "distributedarrays_tpu.utils.autotune",
     "distributedarrays_tpu.utils.profiling",

@@ -171,3 +171,64 @@ def test_error_label_is_not_banked(bench):
     # a rerun failure does NOT unbank (the earlier result stays trusted)
     d2 = {"sort_1e7_s": 1.0, "sort_rerun_error": "boom"}
     assert bench._banked_in(d2, "sort")
+
+
+# ---------------------------------------------------------------------------
+# partial-row banking
+# ---------------------------------------------------------------------------
+
+
+def test_timeout_banks_published_partials_flagged(bench):
+    # a config that published metrics mid-run, then timed out: the
+    # completed metrics land in the row flagged {label}_partial, and the
+    # flag keeps the label un-banked so the next window re-attempts it
+    bench._GLOBAL_BUDGET_S = 1e9
+    d = {}
+
+    def cfg():
+        bench.bank_partial("sort", sort_1e7_s=1.5, sort_iters=42)
+        import time
+        time.sleep(1)
+        return {"sort_1e7_s": 9.9}
+
+    bench._guarded(d, "sort", cfg, timeout_s=0.3)
+    assert "timed out" in d["sort_error"]
+    assert d["sort_1e7_s"] == 1.5 and d["sort_iters"] == 42
+    assert d["sort_partial"] is True
+    assert not bench._banked_in(d, "sort")
+
+
+def test_exception_banks_published_partials_flagged(bench):
+    bench._GLOBAL_BUDGET_S = 1e9
+    d = {}
+
+    def cfg():
+        bench.bank_partial("sort", sort_iters=17)
+        raise ValueError("died after the iteration count")
+
+    bench._guarded(d, "sort", cfg)
+    assert "died after" in d["sort_error"]
+    assert d["sort_iters"] == 17 and d["sort_partial"] is True
+
+
+def test_full_success_supersedes_partial_row(bench):
+    # a later complete run clears the partial flag with the other stale
+    # markers and the label counts as banked again
+    bench._GLOBAL_BUDGET_S = 1e9
+    d = {"sort_1e7_s": 1.5, "sort_partial": True, "sort_error": "old"}
+    assert not bench._banked_in(d, "sort")
+    bench._guarded(d, "sort", lambda: {"sort_1e7_s": 4.5})
+    assert d["sort_1e7_s"] == 4.5
+    assert "sort_partial" not in d and "sort_error" not in d
+    assert bench._banked_in(d, "sort")
+
+
+def test_stale_partials_dropped_at_execution(bench):
+    # publications left over from an earlier attempt never leak into a
+    # fresh run's row (success path shown; _guarded drops them on entry)
+    bench._GLOBAL_BUDGET_S = 1e9
+    bench.bank_partial("sort", sort_iters=99)
+    d = {}
+    bench._guarded(d, "sort", lambda: {"sort_1e7_s": 2.0})
+    assert d["sort_1e7_s"] == 2.0
+    assert "sort_iters" not in d and "sort_partial" not in d

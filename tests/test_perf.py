@@ -438,6 +438,13 @@ def test_regress_direction_inference():
     assert regress.direction("flash_attn_d128_tuned_block") == 0
     assert regress.direction("reshard_even_comm_bytes_est") == 0
     assert regress.direction("something_unknowable") == 0
+    # solver rows: iteration counts and final residuals are
+    # lower-is-better (a regressed preconditioner shows up as MORE
+    # iterations at the same tolerance, not slower ones)
+    assert regress.direction("cg_poisson_iters") == -1
+    assert regress.direction("mgcg_iterations") == -1
+    assert regress.direction("cg_poisson_residual") == -1
+    assert regress.direction("cg_poisson_gbps") == 1
 
 
 def test_regress_replay_detection():

@@ -116,6 +116,38 @@ def test_planner_matches_oracle_random_uneven_cuts(rng):
         dat.d_closeall()
 
 
+def test_planner_matches_oracle_skinny_vector_layouts(rng):
+    # the solver loops re-seat skinny operands between operator
+    # partitions every recovery attempt: (n, 1) column vectors and
+    # single-row-block layouts (one grid row per rank, the degenerate
+    # chunking a StencilOperator on p == nx ranks produces).  Every such
+    # planner pair must equal the plain device_put oracle.
+    row_grids = [(8, 1), (4, 1), (2, 1), (1, 1)]
+    for shape in [(64, 1), (8, 1), (8, 8)]:    # (8, *): 1-row blocks on p=8
+        A = rng.standard_normal(shape).astype(np.float32)
+        for gs, gd in itertools.product(row_grids, row_grids):
+            src, dst = _shardings_for(shape, gs), _shardings_for(shape, gd)
+            x = jax.device_put(A, src)
+            y = R.reshard(x, dst)
+            assert y.sharding == dst or gs == gd, (shape, gs, gd)
+            oracle = jax.device_put(A, dst)
+            np.testing.assert_array_equal(np.asarray(y), np.asarray(oracle),
+                                          err_msg=f"{shape} {gs}->{gd}")
+
+
+def test_samedist_oracle_vector_and_single_row_blocks(rng):
+    # the DArray-level leg of the same sweep: (n, 1) vectors moved with
+    # samedist across rank counts, including single-row blocks (p == n)
+    for n, ps, pd in [(8, 8, 2), (8, 2, 8), (64, 8, 8), (64, 8, 4)]:
+        A = rng.standard_normal((n, 1)).astype(np.float32)
+        d = dat.distribute(A, procs=list(range(ps)), dist=[ps, 1])
+        like = dat.dzeros((n, 1), procs=list(range(pd)), dist=[pd, 1])
+        r = dat.samedist(d, like)
+        np.testing.assert_array_equal(np.asarray(r), A)
+        assert [int(c) for c in r.cuts[0]] == [int(c) for c in like.cuts[0]]
+        dat.d_closeall()
+
+
 def test_planner_replicated_and_gather_strategies(rng):
     shape = (32, 16)
     A = rng.standard_normal(shape).astype(np.float32)

@@ -236,7 +236,7 @@ def test_sp_transformer_zigzag_matches_dense(sp_setup):
     from distributedarrays_tpu.models.ring_attention import zigzag_order
     SPT, C, p, mesh, cfg, params, tokens = sp_setup
     zcfg = SPT.SPConfig(vocab=64, dim=32, heads=4, layers=2, max_seq=32,
-                        dtype=jnp.float32, block_q=4, block_k=4,
+                        dtype=jnp.float32, block_q=8, block_k=8,
                         interpret=True, zigzag=True)
     perm = np.asarray(zigzag_order(32, p))
     zz_tokens = jnp.asarray(np.asarray(tokens)[:, perm])

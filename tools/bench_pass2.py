@@ -61,6 +61,7 @@ BATCHES = [
     ("sp_train_d128", 1300, 1.3),
     ("ring_train", 1000, 1.3),
     ("flash_train", 1000, 1.3),
+    ("cg_poisson", 700, 1.3),
 ]
 MAX_ATTEMPTS = 2
 PROBE_TIMEOUT = 180

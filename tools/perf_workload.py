@@ -80,6 +80,30 @@ futs = [srv.submit("echo", np.full((8, 8), i, dtype=np.float32),
 results = [f.result(timeout=60) for f in futs]
 srv.close()
 
+# -- solver: sparse + stencil SpMV under CG (solver.spmv cost stamps) -------
+# the doctor must classify these HBM-bound (nnz-proportional HBM bytes,
+# halo ICI bytes — arithmetic intensity far under the ridge)
+from distributedarrays_tpu import solvers  # noqa: E402
+
+sop = solvers.StencilOperator((32, 32))
+procs, pdist = sop.vector_layout()
+rhs = np.random.default_rng(5).standard_normal((32, 32)).astype(np.float32)
+bsol = dat.distribute(rhs, procs=procs, dist=list(pdist))
+sres = solvers.cg(sop, bsol, tol=1e-3, maxiter=500)
+assert sres.converged, sres.outcome
+sres.x.close()
+bsol.close()
+
+band = (2.5 * np.eye(96) - np.eye(96, k=1) - np.eye(96, k=-1)).astype(
+    np.float32)
+bop = solvers.SparseOperator(band)
+procs, pdist = bop.vector_layout()
+vb = dat.distribute(np.ones(96, dtype=np.float32), procs=procs,
+                    dist=list(pdist))
+y = bop.apply(vb)
+y.close()
+vb.close()
+
 # -- mapreduce + gather -----------------------------------------------------
 total = dat.dreduce("sum", A)
 g = dat.gather(C)
