@@ -545,6 +545,48 @@ def _replay_row(gflops, cpu_gflops, prov, probe_error) -> dict:
     }
 
 
+def _parse_args(argv=None):
+    """Per-row probe-budget selection for targeted silicon windows.
+
+    ``--rows a,b`` selects the named guarded configs (union with
+    ``DAT_BENCH_ONLY``) and drops the default tunnel-probe budget from
+    900s to 240s: a window aimed at the never-live rows (``ring_gemm``,
+    ``reshard_even``, ``train_step``, ``serve_decode``) should spend its
+    minutes measuring, not re-proving the tunnel the full-run way.
+    ``--probe-budget`` / ``--budget`` override the probe and global
+    deadlines outright; ``--list-rows`` prints the known labels."""
+    import argparse
+    global _ONLY, _GLOBAL_BUDGET_S
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="Hardware bench: headline GEMM + guarded configs.")
+    ap.add_argument("--rows", default=None, metavar="LABEL[,LABEL...]",
+                    help="run only these guarded configs (plus 'headline'"
+                         " to include the headline GEMM); implies a 240s"
+                         " probe budget")
+    ap.add_argument("--probe-budget", type=float, default=None,
+                    metavar="S", help="tunnel-probe budget in seconds "
+                    "(default 900, or 240 with --rows)")
+    ap.add_argument("--budget", type=float, default=None, metavar="S",
+                    help="global bench deadline in seconds "
+                         "(default DAT_BENCH_BUDGET_S or 3300)")
+    ap.add_argument("--list-rows", action="store_true",
+                    help="print the known row labels and exit")
+    args = ap.parse_args(argv)
+    if args.list_rows:
+        print("\n".join(["headline"] + sorted(BANKED_SENTINELS)))
+        raise SystemExit(0)
+    if args.rows:
+        _ONLY = _ONLY | {s.strip() for s in args.rows.split(",")
+                         if s.strip()}
+        os.environ.setdefault("DAT_BENCH_PROBE_BUDGET_S", "240")
+    if args.probe_budget is not None:
+        os.environ["DAT_BENCH_PROBE_BUDGET_S"] = str(args.probe_budget)
+    if args.budget is not None:
+        _GLOBAL_BUDGET_S = float(args.budget)
+    return args
+
+
 def main():
     probe = _probe_with_retry(
         float(os.environ.get("DAT_BENCH_PROBE_BUDGET_S", "900")))
@@ -2116,4 +2158,5 @@ def main():
 
 
 if __name__ == "__main__":
+    _parse_args()
     main()

@@ -292,14 +292,24 @@ def _ring_ag_gemm(A: DArray, B: DArray, out_dtype):
     the (p,1)-row-sharded result array."""
     p = A.pids.shape[0]
     procs = tuple(int(q) for q in A.pids.flat)
-    from .pallas_collectives import rdma_mode
-    rdma = rdma_mode()
+    from . import pallas_collectives as _pc
+    rdma = _pc.rdma_mode()
     m, k = (int(d) for d in A.dims)
     n = int(B.dims[1])
+    # per-shape-class rdma-vs-xla preference (advisor-written); an
+    # explicit DA_TPU_RDMA env wins inside resolve_dispatch, and a
+    # preference can only demote to the XLA ring
+    dispatch_key = _pc.dispatch_key_for("ring_ag", m, n, k, p,
+                                        str(A.dtype))
+    pref, dispatch_src = _pc.resolve_dispatch(dispatch_key)
+    if pref == "xla":
+        rdma = None
     isz = np.dtype(A.dtype).itemsize
     osz = np.dtype(out_dtype).itemsize
     with _tm.span("matmul.ring_ag", ranks=p,
                   dispatch="rdma" if rdma else "xla",
+                  dispatch_key=dispatch_key, dispatch_source=dispatch_src,
+                  shape=[m, k, n], dtype=str(A.dtype),
                   # cost stamp: the ring all-gathers B (each rank's
                   # chunk forwarded p-1 hops) overlapped into the
                   # per-chunk matmuls — the doctor's overlap tier reads

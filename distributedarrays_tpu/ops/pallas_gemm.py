@@ -297,10 +297,17 @@ def pallas_matmul(a, b, block: tuple[int, int, int] | None = None,
     if _tm.enabled():
         # cost stamp on the @traced dispatch span (shapes were unknown
         # when it opened): single-device GEMM, no ICI.  Inline rather
-        # than perf.gemm_cost: a and b can carry different dtypes
+        # than perf.gemm_cost: a and b can carry different dtypes.  The
+        # autotune_key names the exact "pallas_matmul" block entry
+        # _resolve_block consults, so a low_roofline finding on this
+        # span addresses a re-sweepable registry slot.
+        from ..utils import autotune as _at
         _tm.annotate(flops=2 * m * n * ka,
                      bytes_hbm=m * ka * ab + ka * n * bb + m * n * ob,
-                     bytes_ici=0, shape=[m, ka, n])
+                     bytes_ici=0, shape=[m, ka, n],
+                     dtype=[str(a.dtype), str(b.dtype)],
+                     autotune_key=_at.device_key_for(m, n, ka, a.dtype,
+                                                     b.dtype))
 
     bm, bn, bk = _resolve_block(
         m, n, ka, block, interpret, kernel="pallas_matmul",

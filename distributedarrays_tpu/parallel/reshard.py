@@ -492,20 +492,41 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
     rdma = None
     rdma_chunks = 0
     chunks_src = ""
+    autotune_key = ""
+    dispatch_key = ""
+    dispatch_src = ""
     if plan.collective and plan.strategy in ("all_to_all", "all_gather"):
         from ..ops import pallas_collectives as _pc
         rdma = _pc.rdma_mode()
+        dtype_str = str(getattr(x, "dtype", "float32"))
+        # per-shape-class dispatch preference (advisor-written
+        # "rdma_dispatch" entry); an explicit DA_TPU_RDMA env wins inside
+        # resolve_dispatch, and a preference can only demote to XLA — it
+        # never conjures RDMA on a platform rdma_mode rejected
+        dispatch_key = _pc.dispatch_key_for(
+            "reshard", plan.strategy, *plan.shape, dtype_str, plan.nparts)
+        pref, dispatch_src = _pc.resolve_dispatch(dispatch_key)
+        if pref == "xla":
+            rdma = None
         if rdma and plan.strategy == "all_to_all":
             lshape = tuple(s // plan.nparts if d == plan.src_dim else s
                            for d, s in enumerate(plan.shape))
             # the kernel concats along the plan's src dim; clamping here
             # keeps span/bench provenance equal to the depth it runs
             rdma_chunks, chunks_src = _pc.a2a_chunks_for(
-                lshape, str(getattr(x, "dtype", "float32")), plan.nparts,
-                plan.src_dim)
+                lshape, dtype_str, plan.nparts, plan.src_dim)
+            # the exact "rdma_chunks" registry key this depth resolved
+            # under — the advisor addresses its writes by this label
+            autotune_key = _pc.a2a_chunks_key(lshape, dtype_str,
+                                              plan.nparts)
     with _tm.span("reshard", op=op, strategy=plan.strategy,
                   dispatch="rdma" if rdma else "xla",
                   rdma_chunks=rdma_chunks, rdma_chunks_source=chunks_src,
+                  autotune_key=autotune_key, dispatch_key=dispatch_key,
+                  dispatch_source=dispatch_src,
+                  shape=list(plan.shape),
+                  dtype=str(getattr(x, "dtype", "float32")),
+                  src_dim=plan.src_dim, dst_dim=plan.dst_dim,
                   nparts=plan.nparts,
                   # analytic cost stamp (telemetry.perf): every byte
                   # read + rewritten through HBM, the plan's MOVED bytes

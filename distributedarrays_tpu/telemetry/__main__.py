@@ -10,7 +10,9 @@ Usage::
     python -m distributedarrays_tpu.telemetry doctor RUN.jsonl [--platform P]
         [--min-findings N] [--json]
     python -m distributedarrays_tpu.telemetry regress FRESH.json
-        [--baseline DIR_OR_FILE ...] [--json] [--strict]
+        [--baseline DIR_OR_FILE ...] [--json] [--strict] [--explain]
+    python -m distributedarrays_tpu.telemetry advise RUN.jsonl
+        [--apply] [--json] [--platform P] [--min-actions N]
     python -m distributedarrays_tpu.telemetry incident RUN.jsonl [RUN2.jsonl
         ...] [--bundles DIR_OR_FILE ...] [--json] [--trace OUT.json]
         [--strict-bundles]
@@ -316,7 +318,7 @@ def _cmd_regress(args) -> int:
                   sort_keys=True)
         sys.stdout.write("\n")
     else:
-        rg.format_results(results, sys.stdout)
+        rg.format_results(results, sys.stdout, explain=args.explain)
     judged = [r for r in results if r["status"] != "skipped"]
     if not judged:
         print("regress: no metric had a banked baseline to judge "
@@ -324,6 +326,38 @@ def _cmd_regress(args) -> int:
         return 2 if args.strict else 0
     if any(r["status"] == "regression" for r in judged):
         return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# advise: doctor findings -> guarded autotune writes
+# ---------------------------------------------------------------------------
+
+
+def _cmd_advise(args) -> int:
+    from . import advisor, perf
+    events = _read_events_checked(args.journal)
+    analysis = perf.analyze(events, platform=args.platform)
+    actions = advisor.advise(analysis)
+    if args.max_actions:
+        actions = actions[:args.max_actions]
+    results = None
+    if args.apply and actions:
+        results = advisor.apply(actions, repeats=args.repeats,
+                                mad_k=args.mad_k,
+                                rel_floor=args.rel_floor,
+                                persist=not args.no_persist)
+    if args.json:
+        json.dump({"actions": [a.to_dict() for a in actions],
+                   "results": results}, sys.stdout, indent=2,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        advisor.format_results(actions, results, sys.stdout)
+    if args.min_actions and len(actions) < args.min_actions:
+        print(f"advise: {len(actions)} action(s), required at least "
+              f"{args.min_actions}", file=sys.stderr)
+        return 2
     return 0
 
 
@@ -424,7 +458,8 @@ def _cmd_postmortem(args) -> int:
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] in ("summarize", "trace", "prom", "mem",
-                            "postmortem", "doctor", "regress", "incident"):
+                            "postmortem", "doctor", "regress", "incident",
+                            "advise"):
         ap = argparse.ArgumentParser(
             prog="python -m distributedarrays_tpu.telemetry",
             description="Summarize or export a telemetry journal/report.")
@@ -485,9 +520,39 @@ def main(argv=None) -> int:
                        help="relative degradation floor")
         p.add_argument("--strict", action="store_true",
                        help="exit 2 when nothing could be judged")
+        p.add_argument("--explain", action="store_true",
+                       help="print the per-metric median/MAD baseline "
+                            "and direction next to each verdict")
         p.add_argument("--json", action="store_true",
                        help="emit results as JSON")
         p.set_defaults(fn=_cmd_regress)
+        p = sub.add_parser("advise",
+                           help="doctor findings -> tuning actions; "
+                                "--apply executes them under the "
+                                "micro-probe rollback guard")
+        p.add_argument("journal", help="JSONL journal path ('-' = stdin)")
+        p.add_argument("--platform", default=None,
+                       help="peak-table platform for the doctor pass")
+        p.add_argument("--apply", action="store_true",
+                       help="write the proposals (provenance-stamped), "
+                            "micro-probe before/after, auto-roll-back "
+                            "regressions")
+        p.add_argument("--repeats", type=int, default=3,
+                       help="micro-probe samples per side (default 3)")
+        p.add_argument("--mad-k", type=float, default=3.0,
+                       help="MAD multiplier for the rollback threshold")
+        p.add_argument("--rel-floor", type=float, default=0.15,
+                       help="relative regression floor for rollback")
+        p.add_argument("--max-actions", type=int, default=0,
+                       help="cap the number of actions taken (0 = all)")
+        p.add_argument("--min-actions", type=int, default=0,
+                       help="exit 2 unless at least N actions (CI gate)")
+        p.add_argument("--no-persist", action="store_true",
+                       help="keep applied tunes in-process only (default "
+                            "persists to the autotune cache file)")
+        p.add_argument("--json", action="store_true",
+                       help="emit actions + apply results as JSON")
+        p.set_defaults(fn=_cmd_advise)
         p = sub.add_parser("incident",
                            help="merge per-host journals and reconstruct "
                                 "ordered incident reports")

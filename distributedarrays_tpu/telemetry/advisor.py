@@ -1,0 +1,528 @@
+"""Doctor-driven self-tuning: perf findings -> guarded autotune writes.
+
+The observatory diagnoses (``perf.analyze``: unoverlapped_comm /
+low_roofline findings over dispatch-labeled spans) but never acted on
+what it found — chunk depths, GEMM blocks, and rdma-vs-xla dispatch came
+from env knobs and a hand-seeded cache.  This module closes the loop:
+
+- :func:`advise` maps a doctor report to concrete :class:`TuningAction`\\ s
+  via a small decision table —
+
+  ===================  ======================  ===========================
+  finding              registry target         proposal
+  ===================  ======================  ===========================
+  unoverlapped_comm    ``rdma_chunks`` entry   double the chunk depth
+  (rdma span)          for the span's          (more pieces -> more
+                       ``autotune_key``        pipelining, capped at 64)
+  dispatch deltas      ``rdma_dispatch``       pin the measured-faster
+  (rdma-vs-xla         entry for the span's    dispatch for that shape
+  side-by-side)        ``dispatch_key``        class
+  low_roofline on      ``pallas_matmul``       re-sweep block candidates
+  ``pallas.matmul``    block entry             through ``autotune.sweep``
+  ===================  ======================  ===========================
+
+- :func:`apply` executes actions under guard: micro-probe before,
+  provenance-stamped registry write (source=advisor, evidence = finding
+  kind + measured before-metrics, bounded undo journal), micro-probe
+  after, and the pair judged by ``regress.compare`` — a regressing tune
+  is rolled back via ``autotune.undo`` and fires the
+  ``autotune_regressed`` alert, so a bad self-tune is an incident, never
+  a silent slowdown.
+
+Probes are injectable (``probe=``) for deterministic tests; the
+``DA_TPU_ADVISE_PROBE_CMD`` env runs a shell command per sample and uses
+its wall time (harness validation: CI drives the full CLI loop without
+betting on scheduler noise).  Surfaced as ``python -m
+distributedarrays_tpu.telemetry advise [--apply|--json]``; every write /
+rollback is journaled as an ``autotune`` event for the ``summarize``
+tuning-provenance table.  See docs/autotuning.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import statistics
+import subprocess
+import time
+from typing import Any, Callable
+
+from . import core as _core
+from . import regress as _regress
+
+__all__ = ["TuningAction", "advise", "apply", "dispatch_deltas",
+           "default_probe", "format_results", "PROBE_METRIC",
+           "PROBE_CMD_ENV", "MAX_CHUNKS"]
+
+PROBE_METRIC = "advise_probe_s"
+PROBE_CMD_ENV = "DA_TPU_ADVISE_PROBE_CMD"
+MAX_CHUNKS = 64          # resolve_chunks' own derived-depth cap
+# dispatch preference needs a real measured gap, not scheduler jitter
+_DISPATCH_MIN_DELTA = 0.10
+
+
+@dataclasses.dataclass
+class TuningAction:
+    """One proposed registry write.
+
+    ``kind``: ``rdma_chunks`` / ``dispatch`` / ``resweep``; ``kernel`` +
+    ``key`` address the autotune entry; ``proposed`` is the value to
+    write (for ``resweep``, the winner is determined by the sweep at
+    apply time and ``candidates`` carries the block list).  ``probe``
+    is the spec the default micro-probe rebuilds the workload from
+    (shape / dtype / partition info straight off the span labels)."""
+
+    kind: str
+    kernel: str
+    key: str
+    current: Any
+    proposed: Any
+    finding: str
+    evidence: dict
+    probe: dict
+    candidates: list | None = None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if d.get("candidates") is not None:
+            d["candidates"] = [list(c) for c in d["candidates"]]
+        return d
+
+
+# ---------------------------------------------------------------------------
+# the decision table
+# ---------------------------------------------------------------------------
+
+
+def _as_int(v, default=0) -> int:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def _probe_spec(name, labels: dict) -> dict:
+    """Micro-probe reconstruction spec off one span's labels."""
+    labels = labels or {}
+    spec = {"op": name}
+    for k in ("shape", "dtype", "src_dim", "dst_dim", "nparts", "ranks",
+              "strategy"):
+        if labels.get(k) not in (None, ""):
+            spec[k] = labels[k]
+    return spec
+
+
+def dispatch_deltas(analysis: dict) -> list[dict]:
+    """Rdma-vs-xla side-by-side deltas from the doctor's dispatch-labeled
+    overlap stats: for every ``dispatch_key`` observed under BOTH
+    dispatches, the mean duration per class and which one measured
+    faster.  Spans in one class only yield nothing (no comparison)."""
+    by_key: dict[str, dict[str, list]] = {}
+    samples: dict[str, dict] = {}
+    for ov in analysis.get("overlap") or []:
+        labels = ov.get("labels") or {}
+        key = labels.get("dispatch_key")
+        disp = ov.get("dispatch")
+        if not key or disp not in ("rdma", "xla"):
+            continue
+        by_key.setdefault(key, {}).setdefault(disp, []).append(
+            float(ov["dur"]))
+        samples.setdefault(key, {})[disp] = ov
+    out = []
+    for key, sides in by_key.items():
+        if "rdma" not in sides or "xla" not in sides:
+            continue
+        rdma_s = statistics.mean(sides["rdma"])
+        xla_s = statistics.mean(sides["xla"])
+        slower, faster = max(rdma_s, xla_s), min(rdma_s, xla_s)
+        out.append({
+            "key": key,
+            "rdma_s": round(rdma_s, 9), "xla_s": round(xla_s, 9),
+            "n_rdma": len(sides["rdma"]), "n_xla": len(sides["xla"]),
+            "faster": "rdma" if rdma_s <= xla_s else "xla",
+            "delta_frac": round((slower - faster) / slower, 4)
+            if slower > 0 else 0.0,
+            "span": samples[key].get(
+                "rdma" if rdma_s <= xla_s else "xla"),
+        })
+    out.sort(key=lambda d: -d["delta_frac"])
+    return out
+
+
+def advise(analysis: dict) -> list[TuningAction]:
+    """Map one doctor report (``perf.analyze`` output) to tuning actions
+    — at most one per ``(kernel, key)``, worst finding wins.  Pure
+    decision logic: nothing is measured or written here."""
+    from ..utils import autotune
+    actions: dict[tuple, TuningAction] = {}
+    overlaps = {ov.get("span_id"): ov
+                for ov in analysis.get("overlap") or []}
+    classified = {occ.get("span_id"): occ
+                  for occ in analysis.get("classified") or []}
+
+    for f in analysis.get("findings") or []:
+        hint = f.get("action") or {}
+        if f.get("kind") == "unoverlapped_comm" and \
+                hint.get("kernel") == "rdma_chunks":
+            key = hint["key"]
+            if ("rdma_chunks", key) in actions:
+                continue
+            cur = _as_int(hint.get("current"), 0)
+            if cur >= MAX_CHUNKS:
+                continue           # already at the depth cap
+            proposed = min(max(cur * 2, 2), MAX_CHUNKS)
+            if proposed == cur:
+                continue
+            ov = overlaps.get(f.get("span_id")) or {}
+            actions[("rdma_chunks", key)] = TuningAction(
+                kind="rdma_chunks", kernel="rdma_chunks", key=key,
+                current=autotune.get("rdma_chunks", key),
+                proposed=[proposed], finding="unoverlapped_comm",
+                evidence={"severity_s": f.get("severity_s"),
+                          "overlap_frac": ov.get("overlap_frac"),
+                          "unoverlapped_s": ov.get("unoverlapped_s"),
+                          "dur_s": ov.get("dur"),
+                          "chunks": cur},
+                probe=_probe_spec(ov.get("name"), ov.get("labels")))
+        elif f.get("kind") == "low_roofline" and \
+                hint.get("kernel") == "pallas_matmul":
+            key = hint["key"]
+            if ("pallas_matmul", key) in actions:
+                continue
+            shape = hint.get("shape")
+            if not shape or len(shape) != 3:
+                continue
+            occ = classified.get(f.get("span_id")) or {}
+            m, k, n = (_as_int(s) for s in shape)
+            cands = _block_candidates(m, n, k)
+            if not cands:
+                continue
+            actions[("pallas_matmul", key)] = TuningAction(
+                kind="resweep", kernel="pallas_matmul", key=key,
+                current=autotune.get("pallas_matmul", key),
+                proposed=None, finding="low_roofline",
+                evidence={"severity_s": f.get("severity_s"),
+                          "roofline_frac": occ.get("roofline_frac"),
+                          "bound": occ.get("bound"),
+                          "dur_s": occ.get("dur")},
+                probe={"op": "pallas.matmul", "shape": shape,
+                       "dtype": hint.get("dtype")},
+                candidates=cands)
+
+    for d in dispatch_deltas(analysis):
+        key = d["key"]
+        if ("rdma_dispatch", key) in actions:
+            continue
+        if d["delta_frac"] < _DISPATCH_MIN_DELTA:
+            continue
+        cur = autotune.get("rdma_dispatch", key)
+        if cur == d["faster"]:
+            continue               # already pinned to the winner
+        span = d.pop("span", None) or {}
+        actions[("rdma_dispatch", key)] = TuningAction(
+            kind="dispatch", kernel="rdma_dispatch", key=key,
+            current=cur, proposed=d["faster"],
+            finding="dispatch_delta", evidence=dict(d),
+            probe=_probe_spec(span.get("name"), span.get("labels")))
+
+    out = sorted(actions.values(),
+                 key=lambda a: -float(a.evidence.get("severity_s")
+                                      or a.evidence.get("delta_frac")
+                                      or 0.0))
+    for a in out:
+        _core.count("autotune.advisor_actions", kind=a.kind)
+    return out
+
+
+def _block_candidates(m: int, n: int, k: int) -> list[tuple]:
+    """Bounded, divisor-valid block list for a re-sweep of one GEMM
+    shape: per-dim power-of-two divisors around the dims, capped at the
+    f32 tile set — small enough for a micro-sweep, wide enough to move
+    off a mis-tuned entry."""
+    if min(m, n, k) < 1:
+        return []
+
+    def divs(dim, cap):
+        out, b = [], 1
+        while b <= min(dim, cap):
+            if dim % b == 0:
+                out.append(b)
+            b *= 2
+        return out[-3:] or [dim]
+
+    cands = []
+    for bm in divs(m, 512):
+        for bn in divs(n, 512):
+            for bk in divs(k, 512):
+                cands.append((bm, bn, bk))
+    return cands[:24]
+
+
+# ---------------------------------------------------------------------------
+# micro-probes
+# ---------------------------------------------------------------------------
+
+
+def _cmd_probe(action: TuningAction, config=None) -> float:
+    """Wall-time a user-supplied shell command (``DA_TPU_ADVISE_PROBE_CMD``)
+    — the harness-validation hook: the command sees the action's address
+    in its env and the autotune cache via ``DAT_AUTOTUNE_CACHE``."""
+    env = dict(os.environ)
+    env["DA_TPU_ADVISE_KERNEL"] = action.kernel
+    env["DA_TPU_ADVISE_KEY"] = action.key
+    env["DA_TPU_ADVISE_CONFIG"] = json.dumps(config)
+    t0 = time.perf_counter()
+    subprocess.run(os.environ[PROBE_CMD_ENV], shell=True, check=True,
+                   env=env, capture_output=True)
+    return time.perf_counter() - t0
+
+
+def _reshard_probe(action: TuningAction, config=None) -> float:
+    """Re-run the journaled reshard shape class once, eagerly, and time
+    it — registry state at call time (chunk depth, dispatch preference)
+    shapes the dispatch exactly like the real workload."""
+    import numpy as np
+
+    import distributedarrays_tpu as dat
+    spec = action.probe
+    shape = tuple(int(s) for s in spec["shape"])
+    p = int(spec.get("nparts") or spec.get("ranks") or 2)
+    src_dim = int(spec.get("src_dim") or 0)
+    dst_dim = int(spec.get("dst_dim") or (1 if len(shape) > 1 else 0))
+    dtype = np.dtype(str(spec.get("dtype") or "float32"))
+    src_dist = [p if d == src_dim else 1 for d in range(len(shape))]
+    dst_dist = [p if d == dst_dim else 1 for d in range(len(shape))]
+    x = np.zeros(shape, dtype=dtype)
+    E = dat.distribute(x, dist=src_dist)
+    F = dat.dzeros(shape, dtype=dtype, dist=dst_dist)
+    try:
+        t0 = time.perf_counter()
+        dat.copyto_(F, E)
+        F.garray.block_until_ready()
+        return time.perf_counter() - t0
+    finally:
+        E.close()
+        F.close()
+
+
+def _ring_ag_probe(action: TuningAction, config=None) -> float:
+    """Time the overlapped ring GEMM for the journaled shape class."""
+    import numpy as np
+
+    import distributedarrays_tpu as dat
+    from ..ops import linalg
+    spec = action.probe
+    m, k, n = (int(s) for s in spec["shape"])
+    p = int(spec.get("ranks") or spec.get("nparts") or 2)
+    dtype = np.dtype(str(spec.get("dtype") or "float32"))
+    A = dat.distribute(np.zeros((m, k), dtype=dtype), dist=[p, 1])
+    B = dat.distribute(np.zeros((k, n), dtype=dtype), dist=[p, 1])
+    try:
+        t0 = time.perf_counter()
+        C = linalg._ring_ag_gemm(A, B, dtype)
+        C.block_until_ready()
+        return time.perf_counter() - t0
+    finally:
+        A.close()
+        B.close()
+
+
+def _gemm_probe(action: TuningAction, config=None) -> float:
+    """Time ``pallas_matmul`` on the finding's shape; ``config`` (a
+    candidate block) overrides the registry during a re-sweep."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_gemm import pallas_matmul
+    spec = action.probe
+    m, k, n = (int(s) for s in spec["shape"])
+    dts = spec.get("dtype") or ["float32", "float32"]
+    if isinstance(dts, str):
+        dts = [dts, dts]
+    a = jnp.zeros((m, k), dtype=dts[0])
+    b = jnp.zeros((k, n), dtype=dts[1])
+    block = tuple(int(x) for x in config) if config else None
+    t0 = time.perf_counter()
+    pallas_matmul(a, b, block=block).block_until_ready()
+    return time.perf_counter() - t0
+
+
+def default_probe(action: TuningAction, config=None) -> float:
+    """One micro-probe sample (seconds) for ``action`` under the CURRENT
+    registry state (``config`` only overrides during re-sweep candidate
+    timing).  ``DA_TPU_ADVISE_PROBE_CMD`` takes over when set."""
+    if os.environ.get(PROBE_CMD_ENV):
+        return _cmd_probe(action, config)
+    op = str(action.probe.get("op") or "")
+    if action.kind == "resweep" or op == "pallas.matmul":
+        return _gemm_probe(action, config)
+    if op == "matmul.ring_ag":
+        return _ring_ag_probe(action, config)
+    return _reshard_probe(action, config)
+
+
+# ---------------------------------------------------------------------------
+# guarded apply
+# ---------------------------------------------------------------------------
+
+
+def _samples(probe, action, repeats: int) -> list[float]:
+    probe(action)                 # warmup: compile cost is not the tune
+    return [float(probe(action)) for _ in range(repeats)]
+
+
+def apply(actions, *, probe: Callable | None = None, repeats: int = 3,
+          mad_k: float = 3.0, rel_floor: float = 0.15,
+          persist: bool = False, manager=None,
+          evaluate_alerts: bool = True, now: float | None = None) -> list:
+    """Execute tuning actions under the rollback guard.
+
+    Per action: micro-probe ``repeats`` samples before, write the
+    proposal with advisor provenance (``autotune.record`` — bounded undo
+    journal), probe again after, judge the pair with
+    ``regress.compare`` (the before samples ARE the baseline series, so
+    the verdict inherits the sentinel's noise model).  A ``regression``
+    verdict rolls the entry back (``autotune.undo``) and drives the
+    ``autotune_regressed`` alert; anything else keeps the tune.  A
+    ``resweep`` action first runs ``autotune.sweep`` over its candidate
+    blocks (``record_best=False``) to pick the proposal.
+
+    ``probe(action, config=None) -> seconds`` is injectable for
+    deterministic tests; default :func:`default_probe`.  ``persist=True``
+    writes the registry to the default cache after each decision.
+    Returns one result dict per action (``status``: ``applied`` /
+    ``rolled_back`` / ``skipped``)."""
+    from ..utils import autotune
+    from . import alerts
+    probe = probe or default_probe
+    mgr = manager
+    if evaluate_alerts:
+        mgr = mgr if mgr is not None else alerts.default_manager()
+        alerts.ensure_autotune_rule(mgr)
+    results = []
+    for action in actions:
+        res = action.to_dict()
+        try:
+            before = _samples(probe, action, repeats)
+        except Exception as e:
+            res.update(status="skipped",
+                       reason=f"probe failed: {type(e).__name__}: {e}")
+            _core.count("autotune.advisor_skips", kind=action.kind)
+            results.append(res)
+            continue
+        proposed = action.proposed
+        if action.kind == "resweep":
+            try:
+                proposed, sweep_times = autotune.sweep(
+                    action.kernel, action.key, action.candidates,
+                    timer=lambda cfg: probe(action, cfg),
+                    record_best=False)
+                proposed = [int(x) for x in proposed]
+                res["sweep_candidates"] = len(sweep_times)
+            except Exception as e:
+                res.update(status="skipped",
+                           reason=f"sweep failed: {type(e).__name__}: {e}")
+                _core.count("autotune.advisor_skips", kind=action.kind)
+                results.append(res)
+                continue
+        res["proposed"] = proposed
+        if proposed == action.current:
+            res.update(status="skipped", reason="already at proposal",
+                       before_s=before)
+            _core.count("autotune.advisor_skips", kind=action.kind)
+            results.append(res)
+            continue
+        autotune.record(action.kernel, action.key, proposed, provenance={
+            "source": "advisor",
+            "finding": action.finding,
+            "evidence": dict(action.evidence,
+                             before_s=[round(s, 9) for s in before]),
+            "previous": action.current,
+            "ts": time.time(),
+        })
+        _core.count("autotune.advisor_writes", kind=action.kind)
+        try:
+            after = _samples(probe, action, repeats)
+        except Exception as e:
+            # cannot verify: the guarded contract is measure-or-revert
+            autotune.undo(action.kernel, action.key)
+            res.update(status="rolled_back",
+                       reason=f"after-probe failed: "
+                              f"{type(e).__name__}: {e}",
+                       before_s=before)
+            _core.count("autotune.advisor_rollbacks", kind=action.kind)
+            results.append(res)
+            _journal(action, res)
+            if evaluate_alerts:
+                mgr.evaluate(now)
+            continue
+        verdicts = _regress.compare(
+            {PROBE_METRIC: statistics.median(after)},
+            {PROBE_METRIC: before},
+            mad_k=mad_k, rel_floor=rel_floor)
+        verdict = verdicts[0] if verdicts else {"status": "ok"}
+        res.update(before_s=[round(s, 9) for s in before],
+                   after_s=[round(s, 9) for s in after],
+                   verdict=verdict)
+        if verdict.get("status") == "regression":
+            autotune.undo(action.kernel, action.key)
+            res["status"] = "rolled_back"
+            res["reason"] = (
+                f"micro-probe regressed: {verdict['value']:.6g}s vs "
+                f"median {verdict['median']:.6g}s (allowed "
+                f"{verdict['threshold']:.3g})")
+            _core.count("autotune.advisor_rollbacks", kind=action.kind)
+        else:
+            res["status"] = "applied"
+            _core.count("autotune.advisor_applies", kind=action.kind)
+        if persist:
+            autotune.save_default()
+        _journal(action, res)
+        if evaluate_alerts:
+            mgr.evaluate(now)
+        results.append(res)
+    return results
+
+
+def _journal(action: TuningAction, res: dict) -> None:
+    if not _core._ENABLED:
+        return
+    _core.event("autotune", "advise",
+                kernel=action.kernel, key=action.key,
+                kind=action.kind, finding=action.finding,
+                old=action.current, new=res.get("proposed"),
+                status=res["status"], reason=res.get("reason"),
+                before_s=res.get("before_s"), after_s=res.get("after_s"))
+
+
+def format_results(actions: list, results: list | None, out) -> None:
+    """Human rendering for the ``advise`` CLI: one line per action, with
+    apply outcomes when present."""
+    if not actions:
+        out.write("no tuning actions: the journal shows nothing the "
+                  "advisor can address\n")
+        return
+    by_addr = {(r["kernel"], r["key"]): r for r in results or []}
+    for a in actions:
+        d = a.to_dict() if isinstance(a, TuningAction) else dict(a)
+        r = by_addr.get((d["kernel"], d["key"]))
+        status = (r or {}).get("status", "proposed")
+        proposed = (r or {}).get("proposed", d.get("proposed"))
+        out.write(f"{status.upper():<12} {d['kernel']}[{d['key']}]: "
+                  f"{d.get('current')} -> {proposed} "
+                  f"({d['finding']})\n")
+        ev = d.get("evidence") or {}
+        keys = [k for k in ("severity_s", "overlap_frac", "roofline_frac",
+                            "delta_frac", "rdma_s", "xla_s") if
+                ev.get(k) is not None]
+        if keys:
+            out.write("             evidence: " +
+                      "  ".join(f"{k}={ev[k]:.6g}" for k in keys) + "\n")
+        if r and r.get("reason"):
+            out.write(f"             {r['reason']}\n")
+        if r and r.get("before_s") and r.get("after_s"):
+            out.write(
+                f"             probe: before median "
+                f"{statistics.median(r['before_s']):.6g}s, after median "
+                f"{statistics.median(r['after_s']):.6g}s "
+                f"(n={len(r['before_s'])})\n")

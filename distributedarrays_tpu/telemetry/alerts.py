@@ -42,6 +42,7 @@ from typing import Callable
 from . import core, memory
 
 __all__ = ["AlertRule", "AlertManager", "default_rules",
+           "autotune_regressed_rule", "ensure_autotune_rule",
            "start_sampler", "stop_sampler", "sampler_running",
            "SAMPLE_ENV"]
 
@@ -251,6 +252,50 @@ def default_rules(*, p99_slo_s: float = 0.5, shed_slo: float = 0.1,
             threshold=float(min_live_devices), op="<", **win,
             description=f"live devices < {min_live_devices}"))
     return rules
+
+
+def _rollback_delta_signal():
+    """Incremental ``autotune.advisor_rollbacks`` delta between
+    evaluations — same windowed-rate pattern as the shed-fraction
+    signal: a rollback is an *event*, and the process-lifetime total
+    would keep the alert firing forever."""
+    last = {"total": _counter_total("autotune.advisor_rollbacks")}
+
+    def signal() -> float | None:
+        total = _counter_total("autotune.advisor_rollbacks")
+        delta = total - last["total"]
+        last["total"] = total
+        return max(delta, 0.0)
+    return signal
+
+
+def autotune_regressed_rule(*, fast_window_s: float = 60.0,
+                            slow_window_s: float = 300.0) -> AlertRule:
+    """A self-tune that regressed under the advisor's micro-probe and was
+    rolled back is an *incident*, never a silent slowdown: the rule
+    breaches on any new rollback since the previous evaluation (burn
+    fractions near zero — one bad tune among healthy ticks must still
+    page) and clears once the rollback sample ages out of the fast
+    window."""
+    return AlertRule(
+        "autotune_regressed", _rollback_delta_signal(),
+        threshold=0.0, op=">",
+        fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+        fast_burn=0.01, slow_burn=0.01,
+        description="advisor tune regressed under micro-probe; rolled back")
+
+
+def ensure_autotune_rule(manager: AlertManager | None = None) -> AlertRule:
+    """Idempotently register :func:`autotune_regressed_rule` on
+    ``manager`` (default: the process-wide manager); returns the rule
+    installed there."""
+    mgr = manager if manager is not None else _default_manager
+    for r in mgr.rules():
+        if r.name == "autotune_regressed":
+            return r
+    rule = autotune_regressed_rule()
+    mgr.add(rule)
+    return rule
 
 
 # ---------------------------------------------------------------------------

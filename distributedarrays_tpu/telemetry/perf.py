@@ -640,11 +640,47 @@ def _rollup(classified: list) -> dict:
     return out
 
 
+def _action_hint(kind: str, name, labels: dict) -> dict | None:
+    """Machine-readable tuning hint for one finding: the autotune
+    ``kernel`` namespace, the exact registry ``key`` (from the span's
+    ``autotune_key`` / ``dispatch_key`` labels), and a suggested
+    ``direction`` — so the advisor consumes structure instead of
+    re-parsing detail strings.  ``None`` when the span carries no
+    addressable registry key."""
+    labels = labels or {}
+    if kind == "unoverlapped_comm":
+        if labels.get("dispatch") == "rdma" and labels.get("autotune_key"):
+            return {"kernel": "rdma_chunks",
+                    "key": labels["autotune_key"],
+                    "param": "chunks",
+                    "direction": "increase",
+                    "current": labels.get("rdma_chunks"),
+                    "dispatch_key": labels.get("dispatch_key")}
+        if labels.get("dispatch_key"):
+            return {"kernel": "rdma_dispatch",
+                    "key": labels["dispatch_key"],
+                    "param": "dispatch",
+                    "direction": "compare",
+                    "current": labels.get("dispatch")}
+        return None
+    if kind == "low_roofline":
+        if name == "pallas.matmul" and labels.get("autotune_key"):
+            return {"kernel": "pallas_matmul",
+                    "key": labels["autotune_key"],
+                    "param": "block",
+                    "direction": "resweep",
+                    "shape": labels.get("shape"),
+                    "dtype": labels.get("dtype")}
+        return None
+    return None
+
+
 def analyze(events: list, peaks: dict | None = None,
             platform: str | None = None) -> dict:
     """The doctor's full report over one journal: coverage, per-name
     roofline rollups, per-occurrence overlap, the critical path of the
-    longest root, and ranked findings."""
+    longest root, and ranked findings (each carrying a machine-readable
+    ``action`` hint when its span names an autotune registry key)."""
     peaks = peaks or peaks_for(platform)
     classified = classify(events, peaks)
     cov = coverage(events)
@@ -665,6 +701,8 @@ def analyze(events: list, peaks: dict | None = None,
             "kind": "unoverlapped_comm",
             "severity_s": ov["unoverlapped_s"],
             "span_id": ov["span_id"],
+            "action": _action_hint("unoverlapped_comm", ov["name"],
+                                   ov.get("labels")),
             "message": (
                 f"{where} spent {ov['unoverlapped_wall_frac']:.0%} of wall "
                 f"in unoverlapped ICI ({ov['unoverlapped_s']:.6f}s of "
@@ -680,6 +718,8 @@ def analyze(events: list, peaks: dict | None = None,
                 "kind": "low_roofline",
                 "severity_s": round(slack, 9),
                 "span_id": occ["span_id"],
+                "action": _action_hint("low_roofline", occ["name"],
+                                       occ.get("labels")),
                 "message": (
                     f"{occ['name']} ran at {occ['roofline_frac']:.1%} of "
                     f"the {occ['bound']} roofline "
@@ -689,6 +729,7 @@ def analyze(events: list, peaks: dict | None = None,
         findings.append({
             "kind": "coverage_gap",
             "severity_s": round(cov["wall_s"] - cov["attributed_s"], 9),
+            "action": None,
             "message": (
                 f"only {cov['fraction']:.1%} of {cov['wall_s']:.6f}s span "
                 "wall is cost-classified — stamp the missing spans"),

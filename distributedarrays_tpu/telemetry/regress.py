@@ -182,11 +182,17 @@ def compare(fresh: dict, baseline: dict, *, mad_k: float = 3.0,
             "metric": metric, "value": value, "median": med,
             "mad": spread, "n": len(series), "threshold": threshold,
             "delta": delta, "worse_by": worse, "status": status,
+            "direction": "lower_is_better" if d < 0
+            else "higher_is_better",
         })
     return results
 
 
-def format_results(results: list, out) -> None:
+def format_results(results: list, out, explain: bool = False) -> None:
+    """Render compare() verdicts; ``explain`` adds a per-metric baseline
+    line (median / MAD / series size / direction / how the threshold was
+    derived) so a multi-metric verdict is auditable from the text alone,
+    not just the exit code."""
     for r in sorted(results,
                     key=lambda r: (r["status"] != "regression",
                                    -(r.get("worse_by") or 0))):
@@ -198,3 +204,15 @@ def format_results(results: list, out) -> None:
             f"vs median {r['median']:.6g} over {r['n']} banked runs "
             f"(MAD {r['mad']:.3g}, allowed degradation "
             f"{r['threshold']:.3g})\n")
+        if explain:
+            arrow = ("lower is better"
+                     if r.get("direction") == "lower_is_better"
+                     else "higher is better")
+            rule = ("max(mad_k*1.4826*MAD, rel_floor*|median|)"
+                    if r["n"] >= 3 else
+                    "conservative 50% of |median| (fewer than 3 points)")
+            out.write(
+                f"          baseline: median {r['median']:.6g}, "
+                f"MAD {r['mad']:.3g} over n={r['n']}; {arrow}; "
+                f"delta {r['delta']:+.6g} (worse_by {r['worse_by']:.6g}); "
+                f"threshold = {rule}\n")

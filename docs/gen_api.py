@@ -60,6 +60,7 @@ MODULES = [
     "distributedarrays_tpu.telemetry.regress",
     "distributedarrays_tpu.telemetry.cluster",
     "distributedarrays_tpu.telemetry.alerts",
+    "distributedarrays_tpu.telemetry.advisor",
     "distributedarrays_tpu.analysis",
     "distributedarrays_tpu.analysis.divergence",
     "distributedarrays_tpu.analysis.protocol",

@@ -102,6 +102,22 @@ print('elastic-smoke-ok', len(ranks), 'devices')
         rm -f /root/repo/tools/tpu_watch.regression
         echo "=== regress rc=$regress_rc (0=ok, 2=nothing judgeable) ===" >> "$LOG"
       fi
+      # Advisor pass: close the loop from this run's perf findings to
+      # the autotune cache.  Guarded writes only — every applied tune is
+      # micro-probed before/after and auto-rolled-back on regression
+      # (autotune_regressed alert), so a noisy window cannot poison the
+      # cache.  Never fails the watch: advice is advisory.
+      echo "=== autotune advisor (telemetry advise) ===" >> "$LOG"
+      if [ -s "$BENCH_JOURNAL" ]; then
+        DA_TPU_TELEMETRY_JOURNAL=/root/repo/tools/advise_journal.jsonl \
+            timeout 300 python -m distributedarrays_tpu.telemetry advise \
+            "$BENCH_JOURNAL" --apply --json \
+            > /root/repo/tools/advise_out.json 2>> "$LOG" || true
+        cat /root/repo/tools/advise_out.json >> "$LOG"
+        echo "" >> "$LOG"
+      else
+        echo "(no telemetry journal — advisor skipped)" >> "$LOG"
+      fi
       echo "=== RDMA vs XLA (pallas_collectives) ===" >> "$LOG"
       timeout 60 python - >> "$LOG" 2>&1 <<'PYEOF'
 import json
