@@ -8,13 +8,19 @@ inside jitted hot paths, unbound mesh axis names, unguarded telemetry in
 hot paths, DArray leaks in loops.  Two halves:
 
 - **dalint** (``engine``/``rules``): an AST linter with stable rule codes
-  (DAL001-DAL009), per-line ``# dalint: disable=CODE`` suppressions,
-  unused-suppression detection (DAL100), and a CLI — ``python -m
-  distributedarrays_tpu.analysis lint`` or the ``tools/dalint`` wrapper
-  (``--changed`` fast mode, ``--format=json|github``).  Rule catalog:
-  ``docs/analysis.md``.  DAL008/DAL009 delegate to ``locks``, the
-  interprocedural lock-order / blocking-under-lock analysis (cross-file
-  sweep: the ``locks`` CLI verb).
+  (DAL001-DAL012), per-line ``# dalint: disable=CODE`` suppressions,
+  unused-suppression detection (DAL100), a content-hash incremental
+  result cache under ``build/`` (``--no-cache`` to bypass), and a CLI —
+  ``python -m distributedarrays_tpu.analysis lint`` or the
+  ``tools/dalint`` wrapper (``--changed`` fast mode,
+  ``--format=json|github``).  Rule catalog: ``docs/analysis.md``.
+  DAL008/DAL009 delegate to ``locks``, the interprocedural lock-order /
+  blocking-under-lock analysis (cross-file sweep: the ``locks`` CLI
+  verb).  DAL010/011/012 delegate to ``effects``, the interprocedural
+  SPMD effect inference over ``callgraph`` — per-function collective
+  effect signatures with taint summaries; the static divergence prover
+  (cross-file sweep: the ``verify-spmd`` CLI verb, one-signature
+  inspection: the ``effects`` verb).
 - **protocol**: an explicit-state model checker for the Pallas RDMA
   ring-kernel schedules (``ops/ring_schedules.py``) — proves semaphore
   drain, no in-flight slot races, write-once discipline, and absence of
@@ -33,6 +39,11 @@ from .engine import (Finding, lint_source, lint_file, lint_paths,
 from .rules import RULES, Rule
 from .divergence import (CollectiveDivergenceError, DivergenceChecker,
                          checking, payload_signature)
+from .callgraph import CallGraph, Binding, FuncDef
+from .effects import (analyze_paths as analyze_effects,
+                      analyze_sources as analyze_effect_sources,
+                      signature_for, render as render_signature,
+                      EffectReport)
 
 __all__ = [
     "Finding", "lint_source", "lint_file", "lint_paths",
@@ -40,4 +51,7 @@ __all__ = [
     "RULES", "Rule",
     "CollectiveDivergenceError", "DivergenceChecker", "checking",
     "payload_signature",
+    "CallGraph", "Binding", "FuncDef",
+    "analyze_effects", "analyze_effect_sources", "signature_for",
+    "render_signature", "EffectReport",
 ]

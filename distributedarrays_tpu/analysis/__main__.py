@@ -1,7 +1,9 @@
 """dalint CLI.
 
     python -m distributedarrays_tpu.analysis lint [paths...]
-    python -m distributedarrays_tpu.analysis rules
+    python -m distributedarrays_tpu.analysis rules [--json]
+    python -m distributedarrays_tpu.analysis effects <module:fn>
+    python -m distributedarrays_tpu.analysis verify-spmd [paths...]
     python -m distributedarrays_tpu.analysis verify-protocols
     python -m distributedarrays_tpu.analysis locks [paths...]
 
@@ -14,12 +16,25 @@ formats: ``--format=text`` (default), ``json`` (one object per finding),
 that silence nothing (code DAL100, on in CI so justified suppressions
 cannot rot); ``--changed`` lints only files that differ from the git
 merge base (plus uncommitted/untracked) — the pre-commit fast mode.
+Full-catalog runs reuse the content-hash result cache at
+``build/dalint_cache.json`` (``--no-cache`` bypasses it; the summary
+line reports hit/miss counts).
 
-``verify-protocols`` model-checks the declarative RDMA ring-kernel
-schedules (``analysis.protocol``) and refutes the seeded mutants;
-``locks`` runs the cross-file lock-order / blocking-under-lock analysis
-(``analysis.locks``) and prints the acquisition graph.  Both exit 1 on
-failure so they slot straight into CI legs.
+Exit-code contract, uniform across the gate verbs (``lint``,
+``verify-spmd``, ``locks``): **0** = clean (every finding suppressed or
+none exist), **1** = active findings (or a truncated/failed proof),
+**2** = the gate could not run honestly (no targets resolved, bad
+usage, ``--changed`` without a merge base) — distinct from 1 so CI
+never confuses "bugs found" with "nothing was checked".
+
+``effects`` prints one function's interprocedural collective effect
+signature (``analysis.effects``); ``verify-spmd`` is the cross-file
+static SPMD divergence + collective-contract gate (DAL010/011/012 over
+the package, examples, *and* tests); ``verify-protocols`` model-checks
+the declarative RDMA ring-kernel schedules (``analysis.protocol``) and
+refutes the seeded mutants; ``locks`` runs the cross-file lock-order /
+blocking-under-lock analysis (``analysis.locks``) and prints the
+acquisition graph.
 """
 
 from __future__ import annotations
@@ -132,28 +147,100 @@ def _cmd_lint(args) -> int:
                   "root or pass explicit paths)", file=sys.stderr)
             return 2
 
-    from .engine import iter_python_files
+    from .engine import iter_python_files, lint_source
+    # the content-hash cache covers full-catalog runs only (--select
+    # subsets change the finding set; see analysis/cache.py)
+    cache = None
+    if not args.no_cache and select is None:
+        from .cache import LintCache
+        cache = LintCache()
     findings = []
     for f in iter_python_files(paths):
-        per_file = lint_file(f, select)
+        try:
+            src = Path(f).read_text()
+        except (OSError, UnicodeDecodeError) as e:
+            from .engine import Finding
+            findings.append(Finding(str(f), 1, 0, "DAL000", "error",
+                                    f"unreadable file: {e}"))
+            continue
+        hit = cache.lookup(str(f), src) if cache is not None else None
+        if hit is not None:
+            per_file, dal100 = hit
+        else:
+            per_file = lint_source(src, str(f), select)
+            dal100 = unused_suppressions(
+                src, str(f), per_file,
+                select if select is not None else None)
+            if cache is not None:
+                cache.store(str(f), src, per_file, dal100)
         findings.extend(per_file)
         if args.warn_unused_suppressions:
-            try:
-                src = Path(f).read_text()
-            except (OSError, UnicodeDecodeError):
-                continue
-            findings.extend(unused_suppressions(
-                src, str(f), per_file,
-                select if select is not None else None))
+            findings.extend(dal100)
+    if cache is not None:
+        cache.save()
     findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
     active = [f for f in findings if not f.suppressed]
     shown = findings if args.show_suppressed else active
     _emit(shown, args.format)
     n_sup = sum(1 for f in findings if f.suppressed)
     if args.format != "json":
+        cache_note = cache.counters if cache is not None else "cache: off"
         print(f"dalint: {len(active)} finding(s), {n_sup} suppressed, "
-              f"{len(paths)} path(s)")
+              f"{len(paths)} path(s), {cache_note}")
     return 1 if active else 0
+
+
+def _cmd_effects(args) -> int:
+    from . import effects
+
+    try:
+        print(effects.signature_for(args.target, args.paths or None))
+    except ValueError as e:
+        print(f"effects: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def _cmd_verify_spmd(args) -> int:
+    from . import effects
+    from .engine import iter_python_files
+
+    paths = args.paths or [p for p in effects.DEFAULT_EFFECT_TARGETS
+                           if Path(p).exists()]
+    if not paths:
+        print("verify-spmd: no analysis targets found (run from the "
+              "repo root or pass explicit paths)", file=sys.stderr)
+        return 2
+    report = effects.analyze_paths(paths)
+    findings = list(report.findings)
+    # DAL100 integration: a DAL010/011/012 suppression in the swept
+    # files must silence a finding of this very sweep, or it has rotted
+    if args.warn_unused_suppressions:
+        by_path: dict[str, list] = {}
+        for f in report.findings:
+            by_path.setdefault(f.path, []).append(f)
+        for f in iter_python_files(paths):
+            try:
+                src = Path(f).read_text()
+            except (OSError, UnicodeDecodeError):
+                continue
+            findings.extend(unused_suppressions(
+                src, str(f), by_path.get(str(f), []),
+                ("DAL010", "DAL011", "DAL012")))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
+    active = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else active
+    _emit(shown, args.format)
+    if args.format != "json":
+        n_sup = sum(1 for f in findings if f.suppressed)
+        extra = ", TRUNCATED (analysis budget hit — findings " \
+                "incomplete)" if report.truncated else ""
+        print(f"verify-spmd: {len(active)} finding(s), {n_sup} "
+              f"suppressed, {report.functions} function(s), "
+              f"{report.contexts} context(s){extra}")
+    # a truncated sweep proved nothing for the un-analyzed remainder —
+    # fail closed so CI cannot go green on a partial proof
+    return 1 if active or report.truncated else 0
 
 
 def _cmd_verify_protocols(args) -> int:
@@ -223,8 +310,41 @@ def main(argv=None) -> int:
     lint.add_argument("--base", default=None,
                       help="merge-base ref for --changed (default: "
                            "origin/main, origin/master, main, master)")
+    lint.add_argument("--no-cache", action="store_true",
+                      help="bypass the content-hash result cache "
+                           "(build/dalint_cache.json)")
 
-    sub.add_parser("rules", help="print the rule catalog")
+    rules_p = sub.add_parser("rules", help="print the rule catalog")
+    rules_p.add_argument("--json", action="store_true",
+                         help="machine-readable catalog for editor/"
+                              "tooling integration")
+
+    eff = sub.add_parser(
+        "effects",
+        help="print a function's interprocedural collective effect "
+             "signature")
+    eff.add_argument("target", help="module:function (or "
+                                    "path/to/file.py:function, "
+                                    "module:Class.method)")
+    eff.add_argument("paths", nargs="*",
+                     help="analysis surface (default: "
+                          "distributedarrays_tpu examples tests "
+                          "bench.py)")
+
+    vs = sub.add_parser(
+        "verify-spmd",
+        help="cross-file static SPMD divergence + collective-contract "
+             "gate (DAL010/011/012)")
+    vs.add_argument("paths", nargs="*",
+                    help="files or directories (default: "
+                         "distributedarrays_tpu examples tests "
+                         "bench.py)")
+    vs.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
+    vs.add_argument("--show-suppressed", action="store_true")
+    vs.add_argument("--warn-unused-suppressions", action="store_true",
+                    help="report DAL010/011/012 disable= comments that "
+                         "silence nothing in this sweep (DAL100)")
 
     vp = sub.add_parser(
         "verify-protocols",
@@ -257,11 +377,21 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
     if args.cmd == "rules":
-        for code, rule in sorted(RULES.items()):
-            print(f"{code} [{rule.severity}] {rule.title}")
+        if args.json:
+            print(json.dumps([{
+                "code": code, "severity": rule.severity,
+                "title": rule.title,
+            } for code, rule in sorted(RULES.items())], indent=2))
+        else:
+            for code, rule in sorted(RULES.items()):
+                print(f"{code} [{rule.severity}] {rule.title}")
         return 0
     if args.cmd == "lint":
         return _cmd_lint(args)
+    if args.cmd == "effects":
+        return _cmd_effects(args)
+    if args.cmd == "verify-spmd":
+        return _cmd_verify_spmd(args)
     if args.cmd == "verify-protocols":
         return _cmd_verify_protocols(args)
     if args.cmd == "locks":

@@ -36,6 +36,20 @@ has one bad/good pair per rule):
           (the SIGTERM-handler self-deadlock shape); cross-file cycles
           surface via ``python -m distributedarrays_tpu.analysis
           locks``.
+- DAL010  static SPMD divergence: a rank-tainted branch (``myid`` /
+          ``axis_index`` / quorum verdict, propagated through calls,
+          returns, partials and closures) whose arms have
+          non-equivalent collective effect signatures — the static twin
+          of the runtime ``CollectiveDivergenceError`` (engine:
+          ``analysis/effects.py``, interprocedural).
+- DAL011  collective axis name unbound by the mesh context *reaching*
+          the call — DAL004 generalized across calls: mesh axes flow
+          from ``Mesh``/``spmd_mesh``/``mesh_for`` construction sites
+          into callees; cross-file flows surface via ``python -m
+          distributedarrays_tpu.analysis verify-spmd``.
+- DAL012  collective under a rank-tainted loop bound: per-rank
+          iteration counts differ, so per-rank collective counts
+          diverge (the loop-shaped variant of DAL010).
 
 Rules are conservative by design: a rule that cannot prove its premise
 (axis bound elsewhere, value not traced, ...) stays silent.  Intentional
@@ -742,3 +756,43 @@ def _check_dal008(tree, path, lines):
        "lock-order cycle / non-reentrant re-acquisition (deadlock)")
 def _check_dal009(tree, path, lines):
     yield from _lock_findings(tree, path, lines, "DAL009")
+
+
+# ---------------------------------------------------------------------------
+# DAL010/011/012 — interprocedural SPMD effects (analysis/effects.py)
+# ---------------------------------------------------------------------------
+
+# The engine is the effect-signature interpreter in
+# ``analysis/effects.py`` (callgraph + abstract interpretation; it also
+# runs cross-file via the ``verify-spmd`` CLI verb).  The rule catalog
+# exposes its single-file mode so the ordinary lint sweep and the usual
+# suppression syntax apply: taint and collective effects that close
+# within one file — helpers, closures, ``functools.partial`` — are
+# caught here; cross-module flows need ``verify-spmd``.
+
+
+def _effect_findings(tree, path, lines, code):
+    from . import effects as _effects
+    src = "\n".join(lines)
+    for f in _effects.findings_for_source(src, path):
+        if f.code == code:
+            yield (f.line, f.col, f.message)
+
+
+@_rule("DAL010", "error",
+       "static SPMD divergence: rank-tainted branch, non-equivalent "
+       "collective signatures")
+def _check_dal010(tree, path, lines):
+    yield from _effect_findings(tree, path, lines, "DAL010")
+
+
+@_rule("DAL011", "error",
+       "collective axis unbound by the mesh context reaching the call")
+def _check_dal011(tree, path, lines):
+    yield from _effect_findings(tree, path, lines, "DAL011")
+
+
+@_rule("DAL012", "error",
+       "collective under a rank-tainted loop bound")
+def _check_dal012(tree, path, lines):
+    yield from _effect_findings(tree, path, lines, "DAL012")

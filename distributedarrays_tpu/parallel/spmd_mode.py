@@ -469,6 +469,15 @@ def spmd(f: Callable, *args, pids: Sequence[int] | None = None,
             checker = _dv.DivergenceChecker(ctx.pids,
                                             on_mismatch=ctx._failed.set)
         else:
+            # the stderr warning is one-shot and easily lost — journal a
+            # typed event + counter so the doctor and incident
+            # reconstruction can see the coverage gap (this run was NOT
+            # divergence-checked, even though the env var says it was)
+            _tm.count("analysis.divergence_unchecked", backend=backend)
+            if _tm.enabled():
+                _tm.event("divergence", "unchecked_backend",
+                          backend=backend, ranks=len(ctx.pids),
+                          once_key=f"divergence:unchecked:{backend}")
             from ..utils.debug import warn_once
             warn_once("divergence:process-backend",
                       "DA_TPU_CHECK_DIVERGENCE is set but the process "

@@ -35,7 +35,7 @@ def codes(findings, *, suppressed=False):
 
 
 def test_rule_catalog_complete():
-    assert set(RULES) == {f"DAL00{i}" for i in range(1, 10)}
+    assert set(RULES) == {f"DAL{i:03d}" for i in range(1, 13)}
     for code, rule in RULES.items():
         assert rule.severity in ("error", "warning"), code
         assert rule.title, code
@@ -53,7 +53,9 @@ def test_dal001_fires_on_rank_gated_collective():
         "    me = myid()\n"
         "    if me == 0:\n"
         "        barrier()\n")
-    assert codes(lint_source(src)) == ["DAL001"]
+    # the syntactic rule and the interprocedural prover both flag the
+    # shape — DAL001 at the call, DAL010 at the diverging branch
+    assert set(codes(lint_source(src))) == {"DAL001", "DAL010"}
 
 
 def test_dal001_traced_axis_index_variant():
@@ -345,10 +347,11 @@ def test_dal007_suppressible_with_justification():
 def test_inline_suppression_with_justification():
     src = ("from distributedarrays_tpu.parallel import myid, barrier\n"
            "def f():\n"
-           "    if myid() == 0:\n"
+           "    if myid() == 0:  # dalint: disable=DAL010 — test fixture\n"
            "        barrier()  # dalint: disable=DAL001 — test fixture\n")
     fs = lint_source(src)
-    assert codes(fs) == [] and codes(fs, suppressed=True) == ["DAL001"]
+    assert codes(fs) == [] and \
+        sorted(codes(fs, suppressed=True)) == ["DAL001", "DAL010"]
 
 
 def test_file_level_suppression():
@@ -427,7 +430,7 @@ def test_conforming_program_passes_checked(divergence_on):
 def test_rank_divergent_collective_raises_with_sequences(divergence_on):
     # the acceptance-criteria program: a collective under `if rank == 0:`
     def bad():
-        if S.myid() == 0:
+        if S.myid() == 0:  # dalint: disable=DAL010 — seeded divergence: the runtime checker's acceptance fixture; statically cross-validated in test_effects.py
             S.barrier()
         return True
     t0 = time.monotonic()
@@ -443,7 +446,7 @@ def test_rank_divergent_collective_raises_with_sequences(divergence_on):
 
 def test_op_mismatch_at_same_slot(divergence_on):
     def bad():
-        if S.myid() == 0:
+        if S.myid() == 0:  # dalint: disable=DAL010 — seeded divergence: op mismatch at the same slot; statically cross-validated in test_effects.py
             S.barrier()
         else:
             S.bcast("x", root=1)
@@ -457,7 +460,7 @@ def test_op_mismatch_at_same_slot(divergence_on):
 def test_explicit_context_usable_after_divergence(divergence_on):
     ctx = S.context([0, 1])
     def bad():
-        if S.myid() == 0:
+        if S.myid() == 0:  # dalint: disable=DAL010 — seeded divergence: context-reset-after-abort fixture; statically cross-validated in test_effects.py
             S.barrier()
     with pytest.raises(CollectiveDivergenceError):
         S.spmd(bad, context=ctx)
@@ -482,7 +485,7 @@ def test_genuine_error_wins_over_divergence(divergence_on):
 def test_checker_off_means_timeout_not_divergence(monkeypatch):
     monkeypatch.delenv("DA_TPU_CHECK_DIVERGENCE", raising=False)
     def bad():
-        if S.myid() == 0:
+        if S.myid() == 0:  # dalint: disable=DAL010 — seeded divergence: proves the checker-off path times out instead; statically cross-validated in test_effects.py
             S.barrier(timeout=2)
         return True
     with pytest.raises(RuntimeError) as ei:
@@ -495,7 +498,7 @@ def test_mismatch_journaled_as_telemetry_event(divergence_on):
     telemetry.enable()
     try:
         def bad():
-            if S.myid() == 0:
+            if S.myid() == 0:  # dalint: disable=DAL010 — seeded divergence: journaling fixture; statically cross-validated in test_effects.py
                 S.barrier()
             return True
         with pytest.raises(CollectiveDivergenceError):
@@ -513,7 +516,7 @@ def test_checker_unit_payload_signature_in_gather(divergence_on):
     def bad():
         me = S.myid()
         x = np.zeros((me + 1, 4), np.float32)   # different shape per rank
-        S.gather_spmd(x, root=0)
+        S.gather_spmd(x, root=0)  # dalint: disable=DAL010 — seeded divergence: per-rank gather payload shapes; statically cross-validated in test_effects.py
         return True
     with pytest.raises(CollectiveDivergenceError) as ei:
         S.spmd(bad, pids=[0, 1])

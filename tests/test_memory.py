@@ -384,7 +384,7 @@ def test_divergence_produces_one_bundle(telemetry_capture, monkeypatch):
     d = dat.dzeros((8, 8))                         # ledger content at crash
 
     def f():
-        if sm.myid() == 0:
+        if sm.myid() == 0:  # dalint: disable=DAL010 — seeded divergence: flight-recorder bundle fixture; statically cross-validated via verify-spmd
             sm.barrier()
 
     with pytest.raises(CollectiveDivergenceError):
