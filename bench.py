@@ -403,6 +403,7 @@ BANKED_SENTINELS = {
     "train_step": "train_step_s",
     "reshard_uneven": "reshard_uneven_fill_s",
     "reshard_mutate": "reshard_mutate_s",
+    "reshard_multiaxis": "reshard_multiaxis_s",
     "broadcast_chain": "broadcast_chain_8192_s_per_iter",
     "mapreduce": "mapreduce_1e8_s_per_iter",
     "sort": "sort_1e7_s",
@@ -1733,6 +1734,77 @@ def main():
             d.close()
 
     _guarded(details, "reshard_mutate", cfg_reshard_mutate)
+
+    # ---- extra: reshard, multi-axis chain lowering -----------------------
+    # The general per-axis collective chain (PR 19) against the
+    # device_put baseline it demotes: an 8192² two-axis repartition
+    # ((p,1) -> (p/2,2), a single axis-wise all-to-all moving half the
+    # array) and a mesh-axis transpose (gather+a2a+slice).  Banks the
+    # chain strategy and the plan's intra/cross-domain byte split so the
+    # row attributes the win to the hierarchical tier.
+    def cfg_reshard_multiaxis():
+        from distributedarrays_tpu import layout as L_
+        from distributedarrays_tpu.parallel import reshard as R_
+        from jax.sharding import NamedSharding as _NS, \
+            PartitionSpec as _P2
+        p = len(devs)
+        if p < 4 or p % 2:
+            return {"reshard_multiaxis_skipped": f"needs p>=4 even, p={p}"}
+        NR = 8192
+        src = L_.sharding_for(list(range(p)), (p, 1), (NR, NR))
+        dst = L_.sharding_for(list(range(p)), (p // 2, 2), (NR, NR))
+        x = jax.device_put(jax.random.normal(jax.random.key(13), (NR, NR),
+                                             jnp.float32), src)
+        plan = R_.plan_reshard(x, dst)
+
+        def once():
+            y = R_.reshard(x, dst)
+            return float(y[0, 0])          # scalar fetch = sync
+
+        def baseline():
+            y = jax.device_put(x, dst)     # the baseline under measurement
+            return float(y[0, 0])
+
+        once(); baseline()                 # compile/warm both arms
+        t_rs = min(_t(once) for _ in range(3))
+        t_dp = min(_t(baseline) for _ in range(3))
+        out = {
+            "reshard_multiaxis_n": NR,
+            "reshard_multiaxis_nranks": p,
+            "reshard_multiaxis_strategy": plan.strategy,
+            "reshard_multiaxis_steps": ",".join(s[0] for s in plan.steps),
+            "reshard_multiaxis_plan_moved_mb": plan.moved_bytes / 2**20,
+            "reshard_multiaxis_intra_mb": plan.intra_bytes / 2**20,
+            "reshard_multiaxis_cross_mb": plan.cross_bytes / 2**20,
+            "reshard_multiaxis_s": t_rs,
+            "reshard_multiaxis_device_put_s": t_dp,
+        }
+        if plan.moved_bytes:
+            out["reshard_multiaxis_gbps"] = plan.moved_bytes / t_rs / 1e9
+            out["reshard_multiaxis_device_put_gbps"] = \
+                plan.moved_bytes / t_dp / 1e9
+        # the mesh-axis transpose on the destination's (p/2, 2) mesh
+        mesh = L_.mesh_for(list(range(p)), (p // 2, 2))
+        tsrc = _NS(mesh, _P2("d0", "d1"))
+        tdst = _NS(mesh, _P2("d1", "d0"))
+        xt = jax.device_put(jax.random.normal(jax.random.key(17),
+                                              (NR, NR), jnp.float32), tsrc)
+        tplan = R_.plan_reshard(xt, tdst)
+
+        def tonce():
+            y = R_.reshard(xt, tdst)
+            return float(y[0, 0])
+
+        tonce()
+        t_tr = min(_t(tonce) for _ in range(3))
+        out["reshard_multiaxis_transpose_strategy"] = tplan.strategy
+        out["reshard_multiaxis_transpose_s"] = t_tr
+        out["reshard_multiaxis_transpose_moved_mb"] = \
+            tplan.moved_bytes / 2**20
+        return out
+
+    _guarded(details, "reshard_multiaxis", cfg_reshard_multiaxis,
+             timeout_s=600)
 
     # ---- extra: ring GEMM, RDMA vs XLA-ppermute paths --------------------
     # The fused Pallas RDMA collective GEMM (pallas_collectives) against

@@ -257,7 +257,14 @@ def _cmd_verify_protocols(args) -> int:
         ps=ps, depths=depths, mutants=not args.no_mutants, **kw)
     print(protocol.format_report(
         report, verbose_counterexamples=not args.quiet))
-    return 0 if report["ok"] else 1
+    ok = report["ok"]
+    if args.mesh:
+        mesh_report = protocol.verify_mesh_protocols(
+            depths=depths, mutants=not args.no_mutants, **kw)
+        print(protocol.format_report(
+            mesh_report, verbose_counterexamples=not args.quiet))
+        ok = ok and mesh_report["ok"]
+    return 0 if ok else 1
 
 
 def _cmd_locks(args) -> int:
@@ -359,6 +366,11 @@ def main(argv=None) -> int:
                          "kernels (default 1,2)")
     vp.add_argument("--no-mutants", action="store_true",
                     help="skip the mutation harness")
+    vp.add_argument("--mesh", action="store_true",
+                    help="also check the mesh-axis variants (every "
+                         "schedule armed along each axis of 2-D/3-D "
+                         "meshes, p in {2,3,4} per axis) and refute "
+                         "the mesh-geometry mutants")
     vp.add_argument("--max-states", type=int, default=None,
                     help="state budget per schedule (exceeding it is "
                          "a FAILURE, not a pass)")

@@ -73,7 +73,9 @@ from ..ops import ring_schedules as _rs
 
 __all__ = ["CheckResult", "check_schedule", "mutate", "MUTATIONS",
            "verify_protocols", "format_report", "KERNEL_NAMES",
-           "DEFAULT_PS", "DEFAULT_DEPTHS"]
+           "DEFAULT_PS", "DEFAULT_DEPTHS",
+           "check_mesh_schedule", "verify_mesh_protocols",
+           "MESH_MUTATIONS", "DEFAULT_MESHES", "mesh_mutant_addr"]
 
 KERNEL_NAMES = tuple(_rs.SCHEDULES)
 DEFAULT_PS = (2, 3, 4, 5, 8)
@@ -120,6 +122,7 @@ class CheckResult:
     counterexample: list = dataclasses.field(default_factory=list)
     states: int = 0
     mutation: str | None = None
+    method: str | None = None   # mesh variants: "product(...)"/"partition(...)"
 
 
 class _Violation(Exception):
@@ -145,10 +148,19 @@ def _fmt_sem(rank, sem) -> str:
     return f"{sem[0]}[{sem[1]}]@r{rank}"
 
 
-def _concretize(sched: _rs.Schedule, rank: int):
+def _concretize(sched: _rs.Schedule, rank: int, *,
+                me: int | None = None, peer_rank=None):
     """Evaluate one rank's program: every expression becomes an int,
-    regions become global ``(rank, buf, key)`` triples."""
-    env = {"me": rank, "mod": lambda a, n: a % n}
+    regions become global ``(rank, buf, key)`` triples.
+
+    The 1-D case binds ``ME`` to the rank itself and peers evaluate
+    directly to ranks.  Mesh variants bind ``ME`` to the rank's ring
+    POSITION along the armed axis (``me``) and map every evaluated peer
+    position to a global rank through ``peer_rank`` — the checker-side
+    model of the Pallas emitter's ``DeviceIdType.MESH`` device id, and
+    the hook where the mesh-geometry check (peer must sit at that
+    position of this rank's own sub-ring) fires."""
+    env = {"me": rank if me is None else me, "mod": lambda a, n: a % n}
     specs = sched.buffer_specs()
     prog = []
     for idx, ins in enumerate(sched.program):
@@ -162,6 +174,8 @@ def _concretize(sched: _rs.Schedule, rank: int):
             continue
         d = ins.dma
         peer = None if d.peer is None else _rs.ev(d.peer, env)
+        if peer is not None and peer_rank is not None:
+            peer = peer_rank(peer)
         src = (rank, d.src[0], _rs.ev(d.src[1], env))
         dst = ((peer if peer is not None else rank),
                d.dst[0], _rs.ev(d.dst[1], env))
@@ -265,6 +279,14 @@ def check_schedule(sched: _rs.Schedule,
         prog, final, specs = _concretize(sched, r)
         progs.append(prog)
         finals.append(final)
+    return _explore(sched.name, p, nc, progs, finals, specs, max_states)
+
+
+def _explore(name: str, p: int, nc: int, progs, finals, specs,
+             max_states: int) -> CheckResult:
+    """The explorer core over pre-concretized per-rank programs
+    (``p == len(progs)``); mesh variants feed it the full product
+    program of every sub-ring."""
     invisible = _invisible_dmas(progs, specs)
     credit_bufs = {b for b, sp in specs.items() if sp.kind == "credit"}
 
@@ -536,9 +558,9 @@ def check_schedule(sched: _rs.Schedule,
                 seen.add(key)
                 stack.append((nxt, nnode))
     except _Violation as v:
-        return CheckResult(sched.name, p, nc, False, v.kind, v.detail,
+        return CheckResult(name, p, nc, False, v.kind, v.detail,
                            _trace(v.node), states)
-    return CheckResult(sched.name, p, nc, True, states=states)
+    return CheckResult(name, p, nc, True, states=states)
 
 
 # ---------------------------------------------------------------------------
@@ -574,6 +596,189 @@ def mutate(sched: _rs.Schedule, mutation: str) -> _rs.Schedule | None:
                 sched, name=f"{sched.name}!{mutation}",
                 program=tuple(prog))
     return None
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis variants
+# ---------------------------------------------------------------------------
+#
+# A ring kernel armed along ONE axis of an N-D mesh runs an independent
+# sub-ring per combination of the other axes' coordinates
+# (``ring_schedules.mesh_subrings`` is the shared geometry).  The
+# schedules stay symbolic in the ring position, so the mesh variant is
+# a *concretization* question: does every rank's MESH device id land at
+# the addressed position of its OWN sub-ring?  Two proof obligations:
+#
+# 1. **geometry/isolation**: while concretizing each global rank the
+#    ``peer_rank`` hook checks every remote target equals
+#    ``subring[position]`` — any wrong-stride / wrong-axis addressing
+#    (the MESH-device-id bug class) is refuted here with the offending
+#    rank, position and sub-ring in the report;
+# 2. **protocol**: given isolation, sub-rings share no regions, no
+#    semaphores (both are keyed by global rank) and no directed links,
+#    so the product system's reachable states project onto each
+#    factor's and any violation in the product projects into one
+#    sub-ring — the 1-D proof transfers (``partition`` method).  For
+#    small meshes the checker additionally explores the full product
+#    program exhaustively (``product`` method) as defense in depth.
+
+DEFAULT_MESHES = (
+    ((2, 2), 0), ((2, 2), 1),
+    ((3, 2), 0), ((2, 3), 1),
+    ((4, 2), 0), ((2, 4), 1),
+    ((2, 2, 2), 0), ((2, 2, 2), 1), ((2, 2, 2), 2),
+)
+
+# mesh-geometry mutants: compute the MESH device id with the wrong
+# coordinate varied / the wrong flattening stride — the DMA then lands
+# in another sub-ring (or off the mesh) and the isolation check must
+# refute it
+MESH_MUTATIONS = ("mesh-wrong-axis", "mesh-wrong-stride")
+
+# full-product exploration only when the whole mesh has at most this
+# many ranks; larger meshes rely on the partition reduction (which is
+# the actual proof — the product run is redundant coverage).  6-rank
+# products (two p=3 sub-rings) verify too (~90 s across the registry)
+# but are too slow for the CI default; pass product_rank_cap=6 to
+# check_mesh_schedule for the deep run.
+_PRODUCT_RANK_CAP = 4
+
+
+def mesh_mutant_addr(mesh_shape: tuple, axis: int, mutation: str):
+    """A deliberately wrong MESH device-id computation for the mutant
+    harness: returns ``addr(rank, pos) -> global rank``."""
+    ndim = len(mesh_shape)
+    axis = axis % ndim
+    wrong = (axis + 1) % ndim
+    if mutation == "mesh-wrong-axis":
+        # vary the wrong coordinate: peer position replaces the
+        # neighboring axis' coordinate instead of the armed axis'
+        return lambda rank, pos: _rs.mesh_peer(mesh_shape, wrong,
+                                               rank, pos)
+    if mutation == "mesh-wrong-stride":
+        # right ring position, wrong flattening stride
+        p = mesh_shape[axis]
+        stride = 1
+        for d in mesh_shape[axis + 1:]:
+            stride *= d
+        wstride = 1
+        for d in mesh_shape[wrong + 1:]:
+            wstride *= d
+
+        def addr(rank, pos):
+            return rank + (pos - (rank // stride) % p) * wstride
+        return addr
+    raise ValueError(f"unknown mesh mutation {mutation!r}")
+
+
+def check_mesh_schedule(sched: _rs.Schedule, mesh_shape: tuple,
+                        axis: int, *,
+                        max_states: int = DEFAULT_MAX_STATES,
+                        addr=None,
+                        product_rank_cap: int = _PRODUCT_RANK_CAP
+                        ) -> CheckResult:
+    """Check ``sched`` armed along ``axis`` of ``mesh_shape``.  ``addr``
+    (default: ``ring_schedules.mesh_peer``) models the emitter's MESH
+    device id; the mutant harness passes broken ones."""
+    ndim = len(mesh_shape)
+    ax = axis % ndim
+    p = mesh_shape[ax]
+    if p != sched.p:
+        raise ValueError(f"schedule built for p={sched.p} but axis {ax} "
+                         f"of {mesh_shape} has size {p}")
+    total = 1
+    for d in mesh_shape:
+        total *= d
+    nc = dict(sched.params).get("nc", 1)
+    label = (f"{sched.name}@{'x'.join(str(d) for d in mesh_shape)}"
+             f"ax{ax}")
+    rings = _rs.mesh_subrings(mesh_shape, ax)
+    ring_of = {r: ring for ring in rings for r in ring}
+    if addr is None:
+        def addr(rank, pos):
+            return _rs.mesh_peer(mesh_shape, ax, rank, pos)
+    progs, finals = [], []
+    try:
+        for g in range(total):
+            ring = ring_of[g]
+
+            def peer_rank(q, g=g, ring=ring):
+                if not 0 <= q < p:
+                    raise _Violation(
+                        "mesh-geometry",
+                        f"rank {g} addresses ring position {q} outside "
+                        f"0..{p - 1}", None)
+                tgt = addr(g, q)
+                if not 0 <= tgt < total:
+                    raise _Violation(
+                        "mesh-geometry",
+                        f"rank {g} (sub-ring {ring}) addresses device "
+                        f"{tgt}, outside the {mesh_shape} mesh", None)
+                if tgt != ring[q]:
+                    raise _Violation(
+                        "mesh-geometry",
+                        f"rank {g} armed along axis {ax} addresses rank "
+                        f"{tgt} for ring position {q}, but its sub-ring "
+                        f"{ring} has rank {ring[q]} there — the DMA "
+                        f"crosses sub-rings", None)
+                return tgt
+
+            prog, final, specs = _concretize(
+                sched, g, me=ring.index(g), peer_rank=peer_rank)
+            progs.append(prog)
+            finals.append(final)
+    except _Violation as v:
+        return CheckResult(label, p, nc, False, v.kind, v.detail, [], 0)
+    if total <= product_rank_cap:
+        res = _explore(label, total, nc, progs, finals, specs,
+                       max_states)
+        res.p = p
+        res.method = f"product({total} ranks, {len(rings)} sub-rings)"
+        return res
+    # isolation held for every rank, so the mesh program is the disjoint
+    # union of rank-renamed 1-D rings — the 1-D exploration is the proof
+    base = check_schedule(sched, max_states=max_states)
+    res = CheckResult(label, p, nc, base.ok, base.kind, base.detail,
+                      base.counterexample, base.states)
+    res.method = f"partition({len(rings)} sub-rings x 1-D proof)"
+    return res
+
+
+def verify_mesh_protocols(meshes=DEFAULT_MESHES, *,
+                          depths=DEFAULT_DEPTHS, mutants: bool = True,
+                          mutant_mesh: tuple = ((2, 4), 1),
+                          max_states: int = DEFAULT_MAX_STATES) -> dict:
+    """Check every shipped schedule over every ``(mesh_shape, axis)``
+    variant (chunked kernels at each depth), then require the isolation
+    check to refute every mesh-geometry mutant.  Same report shape as
+    :func:`verify_protocols`."""
+    kernels: list[CheckResult] = []
+    for name in KERNEL_NAMES:
+        for mesh_shape, axis in meshes:
+            p = mesh_shape[axis % len(mesh_shape)]
+            ncs = tuple(depths) if name in _CHUNKED else (1,)
+            for nc in ncs:
+                sched = _rs.build(name, p, nc)
+                kernels.append(check_mesh_schedule(
+                    sched, mesh_shape, axis, max_states=max_states))
+    mutant_results: list[CheckResult] = []
+    if mutants:
+        mesh_shape, axis = mutant_mesh
+        for name in KERNEL_NAMES:
+            nc = 2 if name in _CHUNKED else 1
+            sched = _rs.build(name, mesh_shape[axis], nc)
+            for mutation in MESH_MUTATIONS:
+                res = check_mesh_schedule(
+                    sched, mesh_shape, axis, max_states=max_states,
+                    addr=mesh_mutant_addr(mesh_shape, axis, mutation))
+                res.mutation = mutation
+                res.name += f"!{mutation}"
+                mutant_results.append(res)
+    ok = (all(r.ok for r in kernels)
+          and all(not r.ok and r.kind != "state-budget"
+                  for r in mutant_results))
+    return {"ok": ok, "kernels": kernels, "mutants": mutant_results,
+            "skipped": []}
 
 
 # ---------------------------------------------------------------------------
@@ -634,8 +839,9 @@ def format_report(report: dict, *, verbose_counterexamples: bool = True,
     lines = []
     for r in report["kernels"]:
         tag = "OK " if r.ok else "FAIL"
+        via = f" via {r.method}" if r.method else ""
         lines.append(f"{tag} {r.name} p={r.p} nc={r.nc} "
-                     f"({r.states} states)")
+                     f"({r.states} states{via})")
         if not r.ok:
             lines.append(f"     {r.kind}: {r.detail}")
             for t in r.counterexample[-max_trace_lines:]:

@@ -53,7 +53,8 @@ def _cannon_skew_perms(g: int):
 
 
 def allgather_matmul(x, w, axis: str, *, rdma: bool = False,
-                     interpret: bool | None = None):
+                     interpret: bool | None = None,
+                     mesh_axes: tuple | None = None):
     """``all_gather(x, axis) @ w`` with the gather pipelined into the GEMM.
 
     ``x``: this rank's ``(m_loc, k)`` row chunk of the gathered operand;
@@ -69,15 +70,18 @@ def allgather_matmul(x, w, axis: str, *, rdma: bool = False,
 
     ``rdma=True`` arms the fused Pallas RDMA ring
     (``pallas_collectives.ring_allgather_matmul``: next chunk's DMA
-    started before the resident chunk's dot, waited after it) — 1-D
-    meshes, forward-only (no VJP), subject to the VMEM/platform dispatch
-    gate; ineligible calls keep this ``lax`` path.
+    started before the resident chunk's dot, waited after it) —
+    forward-only (no VJP), subject to the VMEM/platform dispatch gate;
+    ineligible calls keep this ``lax`` path.  ``mesh_axes`` (the mesh's
+    full axis-name tuple) arms the ring as a per-axis sub-ring of a
+    multi-axis mesh — compiled TPU only.
     """
     p = _axis_size(axis)
     out_dtype = jnp.result_type(x.dtype, w.dtype)
     if rdma and p > 1:
         from .pallas_collectives import ring_allgather_matmul
-        out = ring_allgather_matmul(x, w, axis, interpret=interpret)
+        out = ring_allgather_matmul(x, w, axis, interpret=interpret,
+                                    mesh_axes=mesh_axes)
         if out is not None:
             return out
     if p == 1:
@@ -102,7 +106,8 @@ def allgather_matmul(x, w, axis: str, *, rdma: bool = False,
 
 
 def allgather_matmul_rhs(a, b, axis: str, *, rdma: bool = False,
-                         interpret: bool | None = None):
+                         interpret: bool | None = None,
+                         mesh_axes: tuple | None = None):
     """``a @ all_gather(b, axis)`` with the gather pipelined into the GEMM
     — the RIGHT-operand twin of ``allgather_matmul``.
 
@@ -119,13 +124,15 @@ def allgather_matmul_rhs(a, b, axis: str, *, rdma: bool = False,
     Ring schedule: at step t the chunk originally from rank ``(r + t) %
     p`` is resident and contracts against ``a[:, src*k_loc:(src+1)*
     k_loc]``; p - 1 hops total.  ``rdma=True`` arms the fused Pallas
-    RDMA ring (see ``allgather_matmul``).
+    RDMA ring; ``mesh_axes`` arms it as a per-axis sub-ring of a
+    multi-axis mesh (see ``allgather_matmul``).
     """
     p = _axis_size(axis)
     out_dtype = jnp.result_type(a.dtype, b.dtype)
     if rdma and p > 1:
         from .pallas_collectives import ring_allgather_matmul_rhs
-        out = ring_allgather_matmul_rhs(a, b, axis, interpret=interpret)
+        out = ring_allgather_matmul_rhs(a, b, axis, interpret=interpret,
+                                        mesh_axes=mesh_axes)
         if out is not None:
             return out
     if p == 1:
@@ -151,7 +158,8 @@ def allgather_matmul_rhs(a, b, axis: str, *, rdma: bool = False,
 
 
 def matmul_reducescatter(x, w, axis: str, *, rdma: bool = False,
-                         interpret: bool | None = None):
+                         interpret: bool | None = None,
+                         mesh_axes: tuple | None = None):
     """``reduce_scatter(x @ w, axis)`` with the reduction pipelined into
     the GEMM.
 
@@ -165,7 +173,8 @@ def matmul_reducescatter(x, w, axis: str, *, rdma: bool = False,
     and forwards.  After p steps every block has collected all p
     contributions and sits on its destination rank; each hop's
     ``pshift`` overlaps the next block's matmul.  ``rdma=True`` arms the
-    fused Pallas RDMA ring (see ``allgather_matmul``).
+    fused Pallas RDMA ring; ``mesh_axes`` arms it as a per-axis sub-ring
+    of a multi-axis mesh (see ``allgather_matmul``).
     """
     p = _axis_size(axis)
     m, _ = x.shape
@@ -174,7 +183,8 @@ def matmul_reducescatter(x, w, axis: str, *, rdma: bool = False,
             f"rows {m} must be divisible by the axis size {p}")
     if rdma and p > 1:
         from .pallas_collectives import ring_matmul_reducescatter
-        out = ring_matmul_reducescatter(x, w, axis, interpret=interpret)
+        out = ring_matmul_reducescatter(x, w, axis, interpret=interpret,
+                                        mesh_axes=mesh_axes)
         if out is not None:
             return out
     r = lax.axis_index(axis)
@@ -358,7 +368,8 @@ def cannon_matmul_int8(a, b, row_axis: str, col_axis: str,
     return (acc + step(qa, qb, sa, sb)).astype(out_dtype)
 
 
-def tp_ffn(x, w1, w2, axis: str, act=None):
+def tp_ffn(x, w1, w2, axis: str, act=None, *,
+           mesh_axes: tuple | None = None):
     """Megatron-style sequence-parallel FFN as one overlapped program:
     ``reduce_scatter(act(all_gather(x) @ W1) @ W2)`` with both
     collectives pipelined into their GEMMs.
@@ -371,7 +382,11 @@ def tp_ffn(x, w1, w2, axis: str, act=None):
     collectives hide behind the two GEMMs.  Differentiable; use inside
     ``shard_map`` (vmap the leading batch dim outside if present).
     ``act``: activation between the GEMMs (default ``jax.nn.gelu``).
+    ``mesh_axes`` names the full axis tuple when the FFN runs on one
+    axis of a multi-axis mesh (per-axis sub-ring arming downstream).
     """
     act = jax.nn.gelu if act is None else act
-    h = allgather_matmul(x, w1, axis)             # (s, f_loc)
-    return matmul_reducescatter(act(h), w2, axis)  # (s_loc, e)
+    h = allgather_matmul(x, w1, axis,
+                         mesh_axes=mesh_axes)      # (s, f_loc)
+    return matmul_reducescatter(act(h), w2, axis,
+                                mesh_axes=mesh_axes)  # (s_loc, e)

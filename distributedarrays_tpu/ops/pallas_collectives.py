@@ -68,10 +68,20 @@ unset, it is derived from ``DA_TPU_RESHARD_CHUNK_MB`` (one chunk stages
 at most one reshard chunk target) with an ``"rdma_chunks"`` autotune
 registry entry taking precedence, the ``pallas_gemm`` pattern.
 
-All kernels assume the named mesh axis is the single axis of a 1-D mesh
-(logical device ids = ring positions) — true for every armed call site:
-the reshard planner's canonical mesh, ``linalg``'s ring_ag mesh, and the
-ring-attention mesh.  Do not arm them on multi-axis meshes.
+Mesh addressing.  On a 1-D mesh the kernels use LOGICAL device ids
+(ring position = device id).  Armed along one axis of a 2-D/3-D mesh —
+pass ``mesh_axes`` (the mesh's full axis-name tuple, in mesh order) —
+they switch to ``DeviceIdType.MESH``: the peer's device id keeps every
+other axis' own coordinate (``lax.axis_index``) and replaces only the
+armed axis' coordinate with the ring position, so each combination of
+the other axes' coordinates runs an independent sub-ring
+(``ring_schedules.mesh_subrings`` is the shared geometry and
+``analysis.protocol.check_mesh_schedule`` proves the variants).  The
+schedules stay symbolic in the ring position — nothing about the
+protocol changes per axis.  One platform gate: Pallas *interpret* mode
+only discharges DMAs on 1-D meshes (``dma_start_p``), so multi-axis
+arming is compiled-TPU-only and every other platform takes the
+bit-equivalent ``lax`` collective fallback (counted as usual).
 """
 
 from __future__ import annotations
@@ -298,7 +308,23 @@ def _credit_scratch():
             pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA]
 
 
-def _emit(sched, me, regions, sems, computes=None):
+def _mesh_device_id(mesh_axes: tuple, axis: str):
+    """MESH device-id builder for a ring armed along ``axis`` of a
+    multi-axis mesh: peer position replaces the armed axis' coordinate,
+    every other coordinate stays mine — the emitter-side twin of
+    ``ring_schedules.mesh_peer`` (the checker refutes any other
+    choice)."""
+    if axis not in mesh_axes:
+        raise ValueError(f"armed axis {axis!r} not in mesh axes "
+                         f"{mesh_axes!r}")
+
+    def device_id(pos):
+        return tuple(pos if a == axis else lax.axis_index(a)
+                     for a in mesh_axes)
+    return device_id
+
+
+def _emit(sched, me, regions, sems, computes=None, device_id=None):
     """Replay a :class:`ring_schedules.Schedule` as Pallas DMA ops.
 
     ``regions`` maps buffer name → ``fn(key) -> ref slice`` (the
@@ -308,7 +334,11 @@ def _emit(sched, me, regions, sems, computes=None):
     instructions rebuild an equal-shaped descriptor from their template
     DMA, the same same-size-drains-one semantics the hand-rolled
     kernels used.  Credit grants/takes arrive as ordinary
-    start/wait-send/wait-recv instructions over the ``cbuf`` buffer."""
+    start/wait-send/wait-recv instructions over the ``cbuf`` buffer.
+
+    ``device_id`` (from :func:`_mesh_device_id`) maps an evaluated ring
+    position to a MESH-coordinate tuple for multi-axis meshes; None
+    keeps the 1-D LOGICAL addressing (position = device id)."""
     env = {"me": me, "mod": _mod}
     slots = sched.sem_slots()
 
@@ -325,11 +355,15 @@ def _emit(sched, me, regions, sems, computes=None):
         if d.peer is None:
             return pltpu.make_async_copy(reg(d.src), reg(d.dst),
                                          sref(d.sem))
+        pos = _rs.ev(d.peer, env)
+        if device_id is None:
+            did, idt = pos, pltpu.DeviceIdType.LOGICAL
+        else:
+            did, idt = device_id(pos), pltpu.DeviceIdType.MESH
         return pltpu.make_async_remote_copy(
             src_ref=reg(d.src), dst_ref=reg(d.dst),
             send_sem=sref(d.send), recv_sem=sref(d.recv),
-            device_id=_rs.ev(d.peer, env),
-            device_id_type=pltpu.DeviceIdType.LOGICAL)
+            device_id=did, device_id_type=idt)
 
     for ins in sched.program:
         if isinstance(ins, _rs.Start):
@@ -344,6 +378,23 @@ def _emit(sched, me, regions, sems, computes=None):
             computes[ins.tag]({k: _rs.ev(v, env) for k, v in ins.args})
 
 
+def _arm_mesh(mode: str | None, axis: str, mesh_axes) -> tuple:
+    """Normalize a kernel's ``(mode, mesh_axes)`` for the armed axis.
+    A 1-D (or omitted) mesh keeps LOGICAL addressing (``None``); a
+    multi-axis mesh keeps the axis tuple for MESH addressing but
+    demotes *interpret* mode to the lax fallback — Pallas interpret
+    mode only discharges DMAs on 1-D meshes (``dma_start_p``)."""
+    if mesh_axes is None or len(mesh_axes) <= 1:
+        return mode, None
+    mesh_axes = tuple(mesh_axes)
+    if axis not in mesh_axes:
+        raise ValueError(f"armed axis {axis!r} not in mesh axes "
+                         f"{mesh_axes!r}")
+    if mode == "interpret":
+        return None, None
+    return mode, mesh_axes
+
+
 # ---------------------------------------------------------------------------
 # ring all-gather
 # ---------------------------------------------------------------------------
@@ -351,7 +402,7 @@ def _emit(sched, me, regions, sems, computes=None):
 
 @functools.lru_cache(maxsize=256)
 def _ag_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
-             interpret: bool):
+             interpret: bool, mesh_axes: tuple | None = None):
     dtype = jnp.dtype(dtype_str)
     blk = shape[dim]
     ndim = len(shape)
@@ -359,12 +410,14 @@ def _ag_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
                       for d, s in enumerate(shape))
 
     sched = _rs.all_gather_schedule(p)
+    did = _mesh_device_id(mesh_axes, axis) if mesh_axes else None
 
     def kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem):
         _emit(sched, lax.axis_index(axis), regions={
             "x": lambda k: x_ref,
             "out": lambda k: _ds_at(o_ref, dim, k[0] * blk, blk, ndim),
-        }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem})
+        }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem},
+            device_id=did)
 
     return pl.pallas_call(
         kernel,
@@ -379,21 +432,23 @@ def _ag_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
 
 
 def ring_all_gather(x, axis: str, *, dim: int = 0,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    mesh_axes: tuple | None = None):
     """``lax.all_gather(x, axis, axis=dim, tiled=True)`` as a Pallas RDMA
     ring (bit-identical: pure data movement).  Falls back to ``pgather``
-    off-TPU."""
+    off-TPU.  ``mesh_axes`` (the full axis tuple of a multi-axis mesh)
+    arms per-axis sub-rings with MESH device ids — compiled TPU only."""
     p = _axis_size(axis)
     if p == 1:
         return x
-    mode = rdma_mode(interpret)
+    mode, mesh_axes = _arm_mesh(rdma_mode(interpret), axis, mesh_axes)
     if mode is None:
         _record_dispatch("ring_all_gather", "xla", x, axis)
         return pgather(x, axis, tiled=True, dim=dim)
     _record_dispatch("ring_all_gather", "rdma", x, axis, p=p, mode=mode)
     shape = tuple(int(s) for s in x.shape)
     return _ag_call(axis, p, shape, str(x.dtype), dim,
-                    mode == "interpret")(x)
+                    mode == "interpret", mesh_axes)(x)
 
 
 # ---------------------------------------------------------------------------
@@ -413,7 +468,7 @@ def _chunk_fit(extent: int, want: int) -> int:
 @functools.lru_cache(maxsize=256)
 def _a2a_call(axis: str, p: int, shape: tuple, dtype_str: str,
               split_dim: int, concat_dim: int, nchunks: int,
-              interpret: bool):
+              interpret: bool, mesh_axes: tuple | None = None):
     dtype = jnp.dtype(dtype_str)
     ndim = len(shape)
     sblk = shape[split_dim] // p
@@ -424,6 +479,7 @@ def _a2a_call(axis: str, p: int, shape: tuple, dtype_str: str,
     nc = _chunk_fit(cext, nchunks)
     piece = cext // nc
     sched = _rs.all_to_all_schedule(p, nc)
+    did = _mesh_device_id(mesh_axes, axis) if mesh_axes else None
 
     def kernel(x_ref, o_ref, send_sem, recv_sem, copy_sem):
         def x_reg(k):
@@ -443,7 +499,8 @@ def _a2a_call(axis: str, p: int, shape: tuple, dtype_str: str,
 
         _emit(sched, lax.axis_index(axis),
               regions={"x": x_reg, "out": o_reg},
-              sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem})
+              sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem},
+              device_id=did)
 
     return pl.pallas_call(
         kernel,
@@ -475,11 +532,13 @@ def a2a_chunks_for(local_shape, dtype_str: str, p: int,
 
 def ring_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
                     chunks: int | None = None,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    mesh_axes: tuple | None = None):
     """``lax.all_to_all(x, axis, split_dim, concat_dim, tiled=True)`` as
     chunked bidirectional direct RDMA (bit-identical: pure data movement;
     every piece lands at its final output offset, zero staging).
-    ``split_dim == concat_dim`` keeps the ``lax`` path."""
+    ``split_dim == concat_dim`` keeps the ``lax`` path.  ``mesh_axes``
+    arms per-axis sub-rings with MESH device ids — compiled TPU only."""
     p = _axis_size(axis)
     if p == 1:
         return x
@@ -488,6 +547,7 @@ def ring_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
     # silent truncation would be wrong data)
     mode = rdma_mode(interpret) if (split_dim != concat_dim
                                     and shape[split_dim] % p == 0) else None
+    mode, mesh_axes = _arm_mesh(mode, axis, mesh_axes)
     if mode is None:
         _record_dispatch("ring_all_to_all", "xla", x, axis)
         return pall_to_all(x, axis, split_dim=split_dim,
@@ -497,7 +557,7 @@ def ring_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
     _record_dispatch("ring_all_to_all", "rdma", x, axis, p=p, mode=mode,
                      chunks=nc, chunks_source=src)
     return _a2a_call(axis, p, shape, str(x.dtype), split_dim, concat_dim,
-                     nc, mode == "interpret")(x)
+                     nc, mode == "interpret", mesh_axes)(x)
 
 
 # ---------------------------------------------------------------------------
@@ -507,7 +567,8 @@ def ring_all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
 
 @functools.lru_cache(maxsize=256)
 def _rs_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
-             nchunks: int, interpret: bool):
+             nchunks: int, interpret: bool,
+             mesh_axes: tuple | None = None):
     dtype = jnp.dtype(dtype_str)
     ndim = len(shape)
     oblk = shape[dim] // p
@@ -524,6 +585,7 @@ def _rs_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
                   for d, s in enumerate(out_shape))
 
     sched = _rs.reduce_scatter_schedule(p, nc)
+    did = _mesh_device_id(mesh_axes, axis) if mesh_axes else None
 
     def kernel(x_ref, o_ref, recv, acc, tmp, send_sem, recv_sem, copy_sem,
                tmp_sem, cbuf, csend, crecv):
@@ -548,7 +610,7 @@ def _rs_call(axis: str, p: int, shape: tuple, dtype_str: str, dim: int,
             "cbuf": lambda k: cbuf,
         }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem,
                  "tmp": tmp_sem, "csend": csend, "crecv": crecv},
-            computes={"accum": accum})
+            computes={"accum": accum}, device_id=did)
 
     return pl.pallas_call(
         kernel,
@@ -579,16 +641,18 @@ def _rs_vmem_bytes(shape, itemsize, p, nc, dim):
 
 def ring_reduce_scatter(x, axis: str, *, dim: int = 0,
                         chunks: int | None = None,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        mesh_axes: tuple | None = None):
     """``lax.psum_scatter(x, axis, scatter_dimension=dim, tiled=True)``
     as a chunked Pallas RDMA traveling-partial ring.  Summation order is
     the ring arrival order (exact for integer-valued data; float results
     differ from XLA's reduction order by rounding only).  Needs the
-    scattered dim divisible by the axis size; falls back otherwise."""
+    scattered dim divisible by the axis size; falls back otherwise.
+    ``mesh_axes`` arms per-axis sub-rings — compiled TPU only."""
     p = _axis_size(axis)
     if p == 1:
         return x
-    mode = rdma_mode(interpret)
+    mode, mesh_axes = _arm_mesh(rdma_mode(interpret), axis, mesh_axes)
     shape = tuple(int(s) for s in x.shape)
     itemsize = jnp.dtype(x.dtype).itemsize
     nc = src = None
@@ -609,7 +673,7 @@ def ring_reduce_scatter(x, axis: str, *, dim: int = 0,
     _record_dispatch("ring_reduce_scatter", "rdma", x, axis, p=p, mode=mode,
                      chunks=nc, chunks_source=src)
     return _rs_call(axis, p, shape, str(x.dtype), dim, nc,
-                    mode == "interpret")(x)
+                    mode == "interpret", mesh_axes)(x)
 
 
 # ---------------------------------------------------------------------------
@@ -640,13 +704,15 @@ def gemm_ring_eligible(kind: str, x_shape, w_shape, p: int, itemsize: int,
 
 @functools.lru_cache(maxsize=128)
 def _ag_mm_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
-                out_dtype_str: str, interpret: bool):
+                out_dtype_str: str, interpret: bool,
+                mesh_axes: tuple | None = None):
     m_loc, k = xs
     n = ws[1]
     dtype = jnp.dtype(dtype_str)
     out_dtype = jnp.dtype(out_dtype_str)
 
     sched = _rs.ag_matmul_schedule(p)
+    did = _mesh_device_id(mesh_axes, axis) if mesh_axes else None
 
     def kernel(x_ref, w_ref, o_ref, buf, send_sem, recv_sem, copy_sem,
                cbuf, csend, crecv):
@@ -664,7 +730,7 @@ def _ag_mm_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
             "cbuf": lambda k: cbuf,
         }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem,
                  "csend": csend, "crecv": crecv},
-            computes={"dot": dot})
+            computes={"dot": dot}, device_id=did)
 
     return pl.pallas_call(
         kernel,
@@ -681,14 +747,16 @@ def _ag_mm_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
 
 
 def ring_allgather_matmul(x, w, axis: str, *,
-                          interpret: bool | None = None):
+                          interpret: bool | None = None,
+                          mesh_axes: tuple | None = None):
     """``allgather_matmul``'s contract as one fused Pallas kernel: the
     next chunk's RDMA is started before the resident chunk's dot and
-    waited after it.  Forward-only (no VJP); callers arm it on 1-D
-    meshes for inference paths."""
+    waited after it.  Forward-only (no VJP); callers arm it on any
+    single mesh axis for inference paths (``mesh_axes`` for multi-axis
+    meshes — compiled TPU only)."""
     p = _axis_size(axis)
     out_dtype = jnp.result_type(x.dtype, w.dtype)
-    mode = rdma_mode(interpret)
+    mode, mesh_axes = _arm_mesh(rdma_mode(interpret), axis, mesh_axes)
     if mode == "compiled" and not gemm_ring_eligible(
             "ag", x.shape, w.shape, p,
             jnp.dtype(x.dtype).itemsize,
@@ -699,18 +767,21 @@ def ring_allgather_matmul(x, w, axis: str, *,
     _record_dispatch("ring_allgather_matmul", "rdma", x, axis, p=p, mode=mode)
     return _ag_mm_call(axis, p, tuple(map(int, x.shape)),
                        tuple(map(int, w.shape)), str(x.dtype),
-                       str(out_dtype), mode == "interpret")(x, w)
+                       str(out_dtype), mode == "interpret",
+                       mesh_axes)(x, w)
 
 
 @functools.lru_cache(maxsize=128)
 def _ag_mm_rhs_call(axis: str, p: int, as_: tuple, bs: tuple,
-                    dtype_str: str, out_dtype_str: str, interpret: bool):
+                    dtype_str: str, out_dtype_str: str, interpret: bool,
+                    mesh_axes: tuple | None = None):
     m_loc, _k = as_
     k_loc, n = bs
     dtype = jnp.dtype(dtype_str)
     out_dtype = jnp.dtype(out_dtype_str)
 
     sched = _rs.ag_matmul_rhs_schedule(p)
+    did = _mesh_device_id(mesh_axes, axis) if mesh_axes else None
 
     def kernel(a_ref, b_ref, o_ref, buf, send_sem, recv_sem, copy_sem,
                cbuf, csend, crecv):
@@ -732,7 +803,7 @@ def _ag_mm_rhs_call(axis: str, p: int, as_: tuple, bs: tuple,
             "cbuf": lambda k: cbuf,
         }, sems={"send": send_sem, "recv": recv_sem, "copy": copy_sem,
                  "csend": csend, "crecv": crecv},
-            computes={"accum_rhs": accum_rhs})
+            computes={"accum_rhs": accum_rhs}, device_id=did)
 
     return pl.pallas_call(
         kernel,
@@ -749,12 +820,13 @@ def _ag_mm_rhs_call(axis: str, p: int, as_: tuple, bs: tuple,
 
 
 def ring_allgather_matmul_rhs(a, b, axis: str, *,
-                              interpret: bool | None = None):
+                              interpret: bool | None = None,
+                              mesh_axes: tuple | None = None):
     """``allgather_matmul_rhs``'s contract fused: the traveling B chunk's
     forward RDMA overlaps the resident chunk's contraction."""
     p = _axis_size(axis)
     out_dtype = jnp.result_type(a.dtype, b.dtype)
-    mode = rdma_mode(interpret)
+    mode, mesh_axes = _arm_mesh(rdma_mode(interpret), axis, mesh_axes)
     if mode == "compiled" and not gemm_ring_eligible(
             "ag_rhs", b.shape, a.shape, p,
             jnp.dtype(b.dtype).itemsize,
@@ -766,18 +838,20 @@ def ring_allgather_matmul_rhs(a, b, axis: str, *,
                      mode=mode)
     return _ag_mm_rhs_call(axis, p, tuple(map(int, a.shape)),
                            tuple(map(int, b.shape)), str(a.dtype),
-                           str(out_dtype), mode == "interpret")(a, b)
+                           str(out_dtype), mode == "interpret",
+                           mesh_axes)(a, b)
 
 
 @functools.lru_cache(maxsize=128)
 def _mm_rs_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
-                interpret: bool):
+                interpret: bool, mesh_axes: tuple | None = None):
     m, k_loc = xs
     n = ws[1]
     m_loc = m // p
     dtype = jnp.dtype(dtype_str)
 
     sched = _rs.matmul_reducescatter_schedule(p)
+    did = _mesh_device_id(mesh_axes, axis) if mesh_axes else None
 
     def kernel(x_ref, w_ref, o_ref, acc, recv, send_sem, recv_sem,
                cbuf, csend, crecv):
@@ -807,7 +881,7 @@ def _mm_rs_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
             "cbuf": lambda k: cbuf,
         }, sems={"send": send_sem, "recv": recv_sem, "csend": csend,
                  "crecv": crecv},
-            computes={"gemm": gemm, "accum": accum})
+            computes={"gemm": gemm, "accum": accum}, device_id=did)
 
     return pl.pallas_call(
         kernel,
@@ -824,11 +898,12 @@ def _mm_rs_call(axis: str, p: int, xs: tuple, ws: tuple, dtype_str: str,
 
 
 def ring_matmul_reducescatter(x, w, axis: str, *,
-                              interpret: bool | None = None):
+                              interpret: bool | None = None,
+                              mesh_axes: tuple | None = None):
     """``matmul_reducescatter``'s contract fused: each destination
     block's GEMM runs while the traveling partial's RDMA is in flight."""
     p = _axis_size(axis)
-    mode = rdma_mode(interpret)
+    mode, mesh_axes = _arm_mesh(rdma_mode(interpret), axis, mesh_axes)
     if mode == "compiled" and not gemm_ring_eligible(
             "rs", x.shape, w.shape, p, jnp.dtype(x.dtype).itemsize,
             jnp.dtype(jnp.result_type(x.dtype, w.dtype)).itemsize):
@@ -840,5 +915,6 @@ def ring_matmul_reducescatter(x, w, axis: str, *,
     out_dtype = jnp.result_type(x.dtype, w.dtype)
     return _mm_rs_call(axis, p, tuple(map(int, x.shape)),
                        tuple(map(int, w.shape)), str(out_dtype),
-                       mode == "interpret")(x.astype(out_dtype),
-                                            w.astype(out_dtype))
+                       mode == "interpret",
+                       mesh_axes)(x.astype(out_dtype),
+                                  w.astype(out_dtype))

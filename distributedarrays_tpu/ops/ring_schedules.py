@@ -52,7 +52,7 @@ __all__ = [
     "all_gather_schedule", "all_to_all_schedule",
     "reduce_scatter_schedule", "ag_matmul_schedule",
     "ag_matmul_rhs_schedule", "matmul_reducescatter_schedule",
-    "a2a_offsets",
+    "a2a_offsets", "mesh_subrings", "mesh_peer", "mesh_axis_size",
 ]
 
 
@@ -546,6 +546,63 @@ def matmul_reducescatter_schedule(p: int) -> Schedule:
          ("g", BufferSpec("scratch"))) + _CREDIT_BUFS,
         (("send", 2), ("recv", 2)) + _CREDIT_SEMS,
         tuple(prog), final)
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis sub-ring geometry
+# ---------------------------------------------------------------------------
+#
+# A ring kernel armed along ONE axis of an N-D mesh runs an independent
+# ring per combination of the other axes' coordinates (a "sub-ring").
+# Schedules stay symbolic in the ring POSITION (``ME``) — nothing above
+# changes — and these helpers are the single source of truth for how
+# positions map to global ranks under the row-major flattening
+# ``layout.mesh_for`` uses.  Both consumers share this geometry: the
+# Pallas emitter builds its ``DeviceIdType.MESH`` ids from the same
+# (position, other-axis coordinates) decomposition, and the protocol
+# checker's mesh concretization uses ``mesh_subrings`` to prove the
+# armed program partitions into disjoint rank-renamed 1-D rings.
+
+
+def mesh_axis_size(mesh_shape: tuple, axis: int) -> int:
+    """Ring width ``p`` of ``axis`` (negative axes index from the end)."""
+    return mesh_shape[axis % len(mesh_shape)]
+
+
+def mesh_subrings(mesh_shape: tuple, axis: int) -> tuple:
+    """Sub-rings along ``axis``: a tuple of rank-tuples, each listing the
+    global (row-major-flattened) ranks of one sub-ring in ring-position
+    order.  Every rank appears in exactly one sub-ring."""
+    ndim = len(mesh_shape)
+    axis = axis % ndim
+    p = mesh_shape[axis]
+    stride = 1
+    for d in mesh_shape[axis + 1:]:
+        stride *= d
+    outer = 1
+    for d in mesh_shape[:axis]:
+        outer *= d
+    rings = []
+    for o in range(outer):
+        for i in range(stride):
+            base = o * p * stride + i
+            rings.append(tuple(base + q * stride for q in range(p)))
+    return tuple(rings)
+
+
+def mesh_peer(mesh_shape: tuple, axis: int, rank: int, pos: int) -> int:
+    """Global rank sitting at ring position ``pos`` of ``rank``'s
+    sub-ring — the scalar twin of the emitter's MESH device id (all
+    coordinates of ``rank`` kept, the ``axis`` coordinate replaced by
+    ``pos``)."""
+    ndim = len(mesh_shape)
+    axis = axis % ndim
+    p = mesh_shape[axis]
+    stride = 1
+    for d in mesh_shape[axis + 1:]:
+        stride *= d
+    my_pos = (rank // stride) % p
+    return rank + (pos - my_pos) * stride
 
 
 # the checker's registry: name -> builder(p, nc); chunkless kernels

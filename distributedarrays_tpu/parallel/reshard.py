@@ -32,12 +32,30 @@ program is what makes it scale.  This module is that planner:
    - shard dim *i* → replicated:     tiled ``all_gather`` per chunk;
    - replicated → shard dim *j*:     a local ``dynamic_slice`` (no comm).
 
-3. **Fall back** — non-divisible, replicated-uneven, multi-dim-grid, and
-   device-set-changing moves keep the ``device_put`` path (compiled
-   identity program when the device set is unchanged).  Either way the
-   chosen strategy is recorded via a ``reshard``/``plan`` journal event
-   and as the ``strategy`` label of the ``reshard`` span, so Perfetto and
-   ``telemetry summarize`` attribute bytes per strategy.
+3. **Lower the general case** — moves no single collective covers
+   (multi-axis repartitions, mesh-axis transposes, partial replication)
+   factorize over a *common refinement* of the two device grids
+   (arXiv 2112.01075): the owner maps are digitized into a mixed-radix
+   mesh whose axes each carry ONE per-axis collective — an
+   ``all_to_all`` for an axis moving between array dims, an
+   ``all_gather`` for an axis leaving, a local dynamic-slice for an axis
+   appearing — composed as one compiled shard_map *chain* (strategy
+   ``chain``).  Start-aligned ceil-uneven layouts ride the same chain
+   between a comm-free pad and slice-back; device-set-shrinking moves
+   whose destination is replicated enough gather collectively on the
+   source mesh first (``gather_put``).  The chain planner is
+   topology-aware: each mesh axis is classified intra- vs cross-domain
+   against ``resilience.domains`` and the plan/span carry
+   ``intra_bytes``/``cross_bytes``, with intra-domain exchanges
+   scheduled first.
+
+4. **Fall back** — whatever remains takes the ``device_put`` path
+   (compiled identity program when the device set is unchanged), counted
+   under ``reshard.collective_fallbacks`` with a canonical ``reason=``
+   label (uneven | multi_axis | device_set | dtype | shape | runtime).
+   Either way the chosen strategy is recorded via a ``reshard``/``plan``
+   journal event and as the ``strategy`` label of the ``reshard`` span,
+   so Perfetto and ``telemetry summarize`` attribute bytes per strategy.
 
 ``dalint`` rule DAL007 flags direct cross-sharding ``jax.device_put`` on
 DArray buffers outside this module, so new code routes through here.
@@ -96,8 +114,20 @@ class ReshardPlan:
     boundary (summed over receiving devices), from the chunk-intersection
     algebra; ``total_bytes`` the logical array size.  ``strategy`` is one
     of ``noop`` (same sharding object), ``all_to_all`` / ``all_gather`` /
-    ``local_slice`` (the compiled single-collective lowerings), or
-    ``device_put`` (fallback; ``reason`` says why)."""
+    ``local_slice`` (the compiled single-collective lowerings), ``chain``
+    / ``gather_put`` (the general per-axis collective chain over the
+    refined mesh — see the module docstring), or ``device_put``
+    (fallback; ``reason`` says why).
+
+    Chain plans also carry: ``mesh_shape`` (refined mesh axis sizes,
+    major→minor over the canonical rank order ``ranks``), ``src_comp`` /
+    ``dst_comp`` (per array dim, the mesh-axis indices sharding it,
+    major→minor), ``steps`` (the scheduled per-axis ops, each
+    ``(kind, axis, q, src_dim, dst_dim, chunk_axis, nchunks,
+    moved_bytes)``), ``pad_shape`` (ceil-uneven layouts: the even analog
+    the chain runs on, between a comm-free pad and slice-back),
+    ``staging_bytes`` (the worst step's staging piece) and the
+    topology split ``intra_bytes``/``cross_bytes``."""
 
     strategy: str
     shape: tuple
@@ -111,10 +141,19 @@ class ReshardPlan:
     chunk_axis: int | None = None
     nchunks: int = 1
     reason: str = ""
+    steps: tuple = ()
+    mesh_shape: tuple = ()
+    src_comp: tuple = ()
+    dst_comp: tuple = ()
+    pad_shape: tuple = ()
+    staging_bytes: int = 0
+    intra_bytes: int = 0
+    cross_bytes: int = 0
 
     @property
     def collective(self) -> bool:
-        return self.strategy in ("all_to_all", "all_gather", "local_slice")
+        return self.strategy in ("all_to_all", "all_gather", "local_slice",
+                                 "chain", "gather_put")
 
 
 def layout_of_sharding(sharding, shape):
@@ -220,6 +259,377 @@ def _pick_chunking(shape, itemsize, src_dim, dst_dim, p, strategy,
     return axis, _smallest_divisor_at_least(units, min(want, units))
 
 
+# ---------------------------------------------------------------------------
+# general lowering: mixed-radix factorization → per-axis collective chain
+# ---------------------------------------------------------------------------
+#
+# arXiv 2112.01075: any even redistribution factorizes over a common
+# refinement of the two layouts' device grids.  We recover that refinement
+# from the owner maps alone: flatten whichever side covers every rank
+# exactly once (row-major over its grid) into a canonical rank order, then
+# split that order's mixed radix until BOTH sides' block coordinates are
+# per-digit linear functions of the rank index.  Each refined digit is one
+# mesh axis, each side becomes a composite PartitionSpec over those axes,
+# and the move is a short schedule of per-axis collectives.  Order is
+# forced by contiguity: a dim's factors leave minor-first and arrive
+# major-first, so every concat/slice touches contiguous blocks.
+
+_MAX_CHAIN_RANKS = 4096
+
+
+def _linear_weight(vals):
+    """The weight w when ``vals`` is v ↦ v*w (w may be 0) — else None."""
+    w = vals[1] if len(vals) > 1 else 0
+    return w if all(v == k * w for k, v in enumerate(vals)) else None
+
+
+def _side_coords(own, pos, nranks):
+    """Per-rank block coordinates in canonical order; None unless every
+    rank owns exactly one block."""
+    out = [None] * nranks
+    for ci, ranks in own.items():
+        for r in ranks:
+            c = pos.get(r)
+            if c is None or out[c] is not None:
+                return None
+            out[c] = ci
+    return None if any(v is None for v in out) else out
+
+
+def _digitize(ndim, s_grid, s_own, d_grid, d_own):
+    """``(canon_ranks, digit_sizes, strides, src_comp, dst_comp)`` — the
+    common mixed-radix refinement of the two owner maps — or None when no
+    such factorization exists (rank-order mismatch, replication on both
+    sides, non-radix block assignment)."""
+    ranks = sorted({r for o in s_own.values() for r in o})
+    nr = len(ranks)
+    if nr > _MAX_CHAIN_RANKS or nr < 2:
+        return None
+    ps = math.prod(s_grid) if s_grid else 1
+    pd = math.prod(d_grid) if d_grid else 1
+    if ps == nr:
+        canon_grid, canon_own = s_grid, s_own
+    elif pd == nr:
+        canon_grid, canon_own = d_grid, d_own
+    else:                    # replication on BOTH sides: no full flatten
+        return None
+    canon = []
+    for coords in itertools.product(*(range(g) for g in canon_grid)):
+        o = canon_own.get(coords, ())
+        if len(o) != 1:
+            return None
+        canon.append(o[0])
+    pos = {r: i for i, r in enumerate(canon)}
+    if len(pos) != nr:
+        return None
+    scoord = _side_coords(s_own, pos, nr)
+    dcoord = _side_coords(d_own, pos, nr)
+    if scoord is None or dcoord is None:
+        return None
+    if any(scoord[0]) or any(dcoord[0]):     # not start-aligned
+        return None
+    digits = []                              # (size, stride), major→minor
+    stride = nr
+    for g in canon_grid:
+        stride //= g
+        if g > 1:
+            digits.append((g, stride))
+    for coord in (scoord, dcoord):
+        for d in range(ndim):
+            k = 0
+            while k < len(digits):
+                q, t = digits[k]
+                vals = [coord[v * t][d] for v in range(q)]
+                if _linear_weight(vals) is not None:
+                    k += 1
+                    continue
+                for a in range(2, q):        # split into (q//a, a)
+                    if q % a:
+                        continue
+                    if all(vals[v] == vals[(v // a) * a] + vals[v % a]
+                           for v in range(q)):
+                        digits[k:k + 1] = [(q // a, t * a), (a, t)]
+                        break
+                else:
+                    return None
+    comps = []
+    for coord in (scoord, dcoord):
+        wmap = {}                            # digit -> (dim, weight)
+        for m, (q, t) in enumerate(digits):
+            hot = [d for d in range(ndim) if coord[t][d]]
+            if len(hot) > 1:                 # one digit, two dims: not a
+                return None                  # valid block grid
+            if hot:
+                wmap[m] = (hot[0], coord[t][hot[0]])
+        comp = []
+        for d in range(ndim):
+            mine = sorted((w, m) for m, (dd, w) in wmap.items() if dd == d)
+            exp = 1
+            for w, m in mine:                # minor → major: exact radix
+                if w != exp:
+                    return None
+                exp *= digits[m][0]
+            comp.append(tuple(m for _w, m in reversed(mine)))
+        for c in range(nr):                  # exhaustive: every rank's
+            for d in range(ndim):            # block decomposes exactly
+                v = sum(((c // digits[m][1]) % digits[m][0]) * wmap[m][1]
+                        for m in comp[d])
+                if v != coord[c][d]:
+                    return None
+        comps.append(tuple(comp))
+    sizes = tuple(q for q, _t in digits)
+    strides = tuple(t for _q, t in digits)
+    return tuple(canon), sizes, strides, comps[0], comps[1]
+
+
+def _digit_cross_domain(canon, q, t):
+    """True when some sub-group along this digit spans failure domains —
+    an exchange along it rides the DCN, not fast intra-domain links."""
+    try:
+        from ..resilience import domains as _dom
+        topo = _dom.topology()
+    except Exception:
+        return False
+
+    def dom(r):
+        try:
+            return topo.domain_of(r)
+        except KeyError:
+            return ("uncovered", r)
+
+    nr = len(canon)
+    for base in range(nr):
+        if (base // t) % q:
+            continue                         # not a group anchor
+        if len({dom(canon[base + v * t]) for v in range(q)}) > 1:
+            return True
+    return False
+
+
+def _schedule_chain(sizes, src_comp, dst_comp, cross):
+    """Ordered ``(kind, digit, src_dim, dst_dim)`` ops transforming the
+    source composites into the destination composites.  When several
+    exchanges are simultaneously legal, intra-domain ones go first (the
+    hierarchical tier: fast links early, the cross-domain residue
+    coalesces into the fewest late exchanges)."""
+    state = [list(c) for c in src_comp]
+    target = [list(c) for c in dst_comp]
+    loc = {m: (j, k) for j, c in enumerate(dst_comp)
+           for k, m in enumerate(c)}
+    ops = []
+    for _ in range(4 * len(sizes) + 4):
+        if state == target:
+            return ops
+        cands = []
+        for i, st in enumerate(state):
+            if not st:
+                continue
+            m = st[-1]
+            at = loc.get(m)
+            if at is not None:
+                j, k = at
+                if j != i and len(state[j]) == k and \
+                        state[j] == target[j][:k]:
+                    cands.append((cross.get(m, False), i,
+                                  ("a2a", m, i, j)))
+        if cands:
+            op = min(cands)[2]
+            _kind, m, i, j = op
+            state[i].pop()
+            state[j].append(m)
+            ops.append(op)
+            continue
+        placed = {m for st in state for m in st}
+        progressed = False
+        for j, tg in enumerate(target):
+            k = len(state[j])
+            if k < len(tg) and state[j] == tg[:k] and tg[k] not in placed:
+                ops.append(("slice", tg[k], None, j))
+                state[j].append(tg[k])
+                progressed = True
+                break
+        if progressed:
+            continue
+        # unblock first: if some digit could a2a into dim j but j's tail
+        # holds extra digits past the correct prefix, gathering j's tail
+        # enables the cheaper exchange (gather+a2a beats gather+gather
+        # for a mesh-axis transpose)
+        for i, st in enumerate(state):
+            if not st:
+                continue
+            at = loc.get(st[-1])
+            if at is None:
+                continue
+            j, k = at
+            if j != i and len(state[j]) > k and \
+                    state[j][:k] == target[j][:k]:
+                ops.append(("gather", state[j][-1], j, None))
+                state[j].pop()
+                progressed = True
+                break
+        if progressed:
+            continue
+        for i, st in enumerate(state):
+            if st and st != target[i][:len(st)]:
+                ops.append(("gather", st[-1], i, None))
+                st.pop()
+                progressed = True
+                break
+        if not progressed:
+            return None
+    return None
+
+
+def _pick_step_chunking(local, itemsize, concat_dim, split_dim, q,
+                        chunk_target):
+    """(chunk_axis, nchunks) for one chain step — :func:`_pick_chunking`
+    over the step's evolving LOCAL shape.  -1 = unchunked."""
+    lbytes = math.prod(local) * itemsize
+    want = -(-lbytes // chunk_target)
+    if want <= 1:
+        return -1, 1
+    cands = []
+    for d in range(len(local)):
+        if d == concat_dim:
+            continue
+        units = local[d] // q if d == split_dim else local[d]
+        if units > 1:
+            cands.append((units, d))
+    if not cands:
+        return -1, 1
+    units, axis = max(cands)
+    return axis, _smallest_divisor_at_least(units, min(want, units))
+
+
+def _chain_steps(shape, itemsize, sizes, strides, src_comp, ops, canon,
+                 cross, chunk_target):
+    """Resolve scheduled ops into executable steps with per-step
+    chunking, moved bytes, the staging high-water, and the intra/cross
+    domain byte split."""
+    nr = len(canon)
+    local = [shape[d] // math.prod([sizes[m] for m in src_comp[d]] or [1])
+             for d in range(len(shape))]
+    steps = []
+    moved = staging = intra = crossb = 0
+    for kind, m, i, j in ops:
+        q = sizes[m]
+        lelems = math.prod(local) if local else 1
+        ca, nc, mstep, stg = -1, 1, 0, 0
+        if kind == "a2a":
+            ca, nc = _pick_step_chunking(local, itemsize, i, j, q,
+                                         chunk_target)
+            mstep = nr * (lelems - lelems // q) * itemsize
+            local[i] *= q
+            local[j] //= q
+            stg = -(-(lelems * itemsize) // max(nc, 1))
+        elif kind == "gather":
+            # the transient is the GATHERED output (q x the input), so
+            # both the chunk count and the staging watermark budget
+            # against the post-gather local shape
+            local[i] *= q
+            ca, nc = _pick_step_chunking(local, itemsize, i, None, q,
+                                         chunk_target)
+            mstep = nr * lelems * (q - 1) * itemsize
+            stg = -(-(lelems * q * itemsize) // max(nc, 1))
+        else:                                # slice: no comm, no staging
+            local[j] //= q
+        moved += mstep
+        staging = max(staging, stg)
+        if cross.get(m, False) and kind != "slice":
+            crossb += mstep
+        else:
+            intra += mstep
+        steps.append((kind, m, q, -1 if i is None else i,
+                      -1 if j is None else j, ca, nc, mstep))
+    return tuple(steps), moved, staging, intra, crossb
+
+
+def _try_chain(shape, itemsize, s_grid, s_own, d_grid, d_own, total,
+               chunk_target, pad_shape=()):
+    """A ``chain`` plan for the even general case (on ``pad_shape``, the
+    even analog, when the real layouts are ceil-uneven) — None when the
+    layouts don't share a mixed-radix refinement."""
+    work = tuple(pad_shape) or tuple(shape)
+    dig = _digitize(len(work), s_grid, s_own, d_grid, d_own)
+    if dig is None:
+        return None
+    canon, sizes, strides, src_comp, dst_comp = dig
+    if not sizes:
+        return None
+    for comp in (src_comp, dst_comp):
+        for d in range(len(work)):
+            if work[d] % math.prod([sizes[m] for m in comp[d]] or [1]):
+                return None
+    cross = {m: _digit_cross_domain(canon, sizes[m], strides[m])
+             for m in range(len(sizes))}
+    ops = _schedule_chain(sizes, src_comp, dst_comp, cross)
+    if not ops:
+        return None
+    steps, moved, staging, intra, crossb = _chain_steps(
+        work, itemsize, sizes, strides, src_comp, ops, canon, cross,
+        chunk_target)
+    return ReshardPlan("chain", tuple(shape), itemsize, moved, total,
+                       nparts=len(canon), ranks=canon,
+                       nchunks=max(s[6] for s in steps),
+                       steps=steps, mesh_shape=sizes, src_comp=src_comp,
+                       dst_comp=dst_comp,
+                       pad_shape=tuple(pad_shape)
+                       if tuple(pad_shape) != tuple(shape) else (),
+                       staging_bytes=staging, intra_bytes=intra,
+                       cross_bytes=crossb)
+
+
+def _try_pad_chain(shape, itemsize, s_cuts, s_own, d_cuts, d_own, total,
+                   chunk_target):
+    """Start-aligned ceil-uneven layouts whose per-dim pads agree: run
+    the even chain on the padded analog between a comm-free pad and
+    slice-back (ceil cuts put every pad byte on the trailing shard)."""
+    pad = []
+    for d, n in enumerate(shape):
+        need = None
+        for cuts in (s_cuts[d], d_cuts[d]):
+            g = len(cuts) - 1
+            if g <= 1:
+                continue
+            c = cuts[1] - cuts[0]
+            if c <= 0 or list(cuts) != [min(k * c, n) for k in range(g + 1)]:
+                return None                  # not start-aligned ceil cuts
+            want = g * c
+            if need is None:
+                need = want
+            elif need != want:
+                return None                  # the sides' pads disagree
+        pad.append(need if need is not None else n)
+    if tuple(pad) == tuple(shape):
+        return None                          # actually even: not ours
+    return _try_chain(shape, itemsize, _grid_of(s_cuts), s_own,
+                      _grid_of(d_cuts), d_own, total, chunk_target,
+                      pad_shape=tuple(pad))
+
+
+def _try_gather_put(shape, itemsize, s_grid, s_own, d_own, total,
+                    chunk_target):
+    """Device-set-shrinking moves (elastic re-layout): when the
+    destination is replicated enough — fewer blocks than ranks, the
+    signature of ``layout.sharding_for``'s divisibility rule after an
+    uneven shrink — gather collectively ON the source mesh, then
+    restrict to the survivors with a comm-free device_put (every
+    survivor already holds the bytes)."""
+    s_ranks = sorted({r for o in s_own.values() for r in o})
+    d_ranks = {r for o in d_own.values() for r in o}
+    if not d_ranks < set(s_ranks):
+        return None
+    if len(d_own) >= len(d_ranks):
+        return None                  # properly sharded: device_put wins
+    ndim = len(shape)
+    rep_own = {tuple([0] * ndim): tuple(s_ranks)}
+    plan = _try_chain(shape, itemsize, s_grid, s_own,
+                      tuple([1] * ndim), rep_own, total, chunk_target)
+    if plan is None:
+        return None
+    return dataclasses.replace(plan, strategy="gather_put")
+
+
 @functools.lru_cache(maxsize=512)
 def _plan_cached(shape, itemsize, src_sharding, dst_sharding,
                  chunk_target) -> ReshardPlan:
@@ -254,66 +664,91 @@ def _build_plan(shape, itemsize, src, dst, chunk_target) -> ReshardPlan:
         return fallback(f"opaque layouts ({type(e).__name__})")
     s_ranks_all = {r for own in s_own.values() for r in own}
     d_ranks_all = {r for own in d_own.values() for r in own}
-    if s_ranks_all != d_ranks_all:
-        return fallback("device sets differ", moved)
     s_grid, d_grid = _grid_of(s_cuts), _grid_of(d_cuts)
+    # uniform start-0/end-n cuts are automatically divisible
+    even = all(_uniform(c) for c in s_cuts) and \
+        all(_uniform(c) for c in d_cuts)
+    if s_ranks_all != d_ranks_all:
+        if even and d_ranks_all < s_ranks_all:
+            gp = _try_gather_put(shape, itemsize, s_grid, s_own, d_own,
+                                 total, chunk_target)
+            if gp is not None:
+                return gp
+        return fallback("device sets differ", moved)
+    if not even:
+        pc = _try_pad_chain(shape, itemsize, s_cuts, s_own, d_cuts, d_own,
+                            total, chunk_target)
+        if pc is not None:
+            return pc
+        if any(not _uniform(c) for c in s_cuts):
+            return fallback("uneven source shards", moved)
+        return fallback("uneven destination shards", moved)
     s_sh = [d for d, g in enumerate(s_grid) if g > 1]
     d_sh = [d for d, g in enumerate(d_grid) if g > 1]
-    if len(s_sh) > 1 or len(d_sh) > 1:
-        return fallback("multi-dim chunk grid", moved)
-    if not _uniform(s_cuts[s_sh[0]] if s_sh else [0]) or \
-            (s_sh and shape[s_sh[0]] % s_grid[s_sh[0]]):
-        return fallback("uneven source shards", moved)
-    if not _uniform(d_cuts[d_sh[0]] if d_sh else [0]) or \
-            (d_sh and shape[d_sh[0]] % d_grid[d_sh[0]]):
-        return fallback("uneven destination shards", moved)
 
-    if s_sh and d_sh:
+    why = None
+    if len(s_sh) > 1 or len(d_sh) > 1:
+        why = "multi-dim chunk grid"
+    elif s_sh and d_sh:
         i, j = s_sh[0], d_sh[0]
         p = s_grid[i]
         if i == j or d_grid[j] != p:
-            return fallback("incompatible repartition widths", moved)
-        src_order = _singleton_rank_order(s_own, s_grid, i)
-        dst_order = _singleton_rank_order(d_own, d_grid, j)
-        if src_order is None or dst_order is None or src_order != dst_order:
-            return fallback("replicated blocks or rank order differs", moved)
-        if shape[j] % p:
-            return fallback("dst dim not divisible", moved)
-        ca, nc = _pick_chunking(shape, itemsize, i, j, p, "all_to_all",
-                                chunk_target)
-        return ReshardPlan("all_to_all", shape, itemsize, moved, total,
-                           src_dim=i, dst_dim=j, nparts=p, ranks=src_order,
-                           chunk_axis=ca, nchunks=nc)
-    if s_sh and not d_sh:
+            why = "incompatible repartition widths"
+        else:
+            src_order = _singleton_rank_order(s_own, s_grid, i)
+            dst_order = _singleton_rank_order(d_own, d_grid, j)
+            if src_order is None or dst_order is None or \
+                    src_order != dst_order:
+                why = "replicated blocks or rank order differs"
+            else:
+                ca, nc = _pick_chunking(shape, itemsize, i, j, p,
+                                        "all_to_all", chunk_target)
+                return ReshardPlan("all_to_all", shape, itemsize, moved,
+                                   total, src_dim=i, dst_dim=j, nparts=p,
+                                   ranks=src_order, chunk_axis=ca,
+                                   nchunks=nc)
+    elif s_sh:
         i = s_sh[0]
         p = s_grid[i]
         src_order = _singleton_rank_order(s_own, s_grid, i)
         if src_order is None:
-            return fallback("replicated source blocks", moved)
-        ca, nc = _pick_chunking(shape, itemsize, i, None, p, "all_gather",
-                                chunk_target)
-        return ReshardPlan("all_gather", shape, itemsize, moved, total,
-                           src_dim=i, dst_dim=None, nparts=p,
-                           ranks=src_order, chunk_axis=ca, nchunks=nc)
-    if d_sh and not s_sh:
+            why = "replicated source blocks"
+        else:
+            ca, nc = _pick_chunking(shape, itemsize, i, None, p,
+                                    "all_gather", chunk_target)
+            return ReshardPlan("all_gather", shape, itemsize, moved, total,
+                               src_dim=i, dst_dim=None, nparts=p,
+                               ranks=src_order, chunk_axis=ca, nchunks=nc)
+    elif d_sh:
         j = d_sh[0]
         p = d_grid[j]
         dst_order = _singleton_rank_order(d_own, d_grid, j)
         if dst_order is None:
-            return fallback("replicated destination blocks", moved)
-        # every dst device must already hold the (replicated) source
-        src_everywhere = all(set(dst_order) <= set(own)
-                             for own in s_own.values())
-        if not src_everywhere:
-            return fallback("source not replicated on dst devices", moved)
-        return ReshardPlan("local_slice", shape, itemsize, 0, total,
-                           src_dim=None, dst_dim=j, nparts=p,
-                           ranks=dst_order)
-    if moved == 0:
+            why = "replicated destination blocks"
+        else:
+            # every dst device must already hold the (replicated) source
+            src_everywhere = all(set(dst_order) <= set(own)
+                                 for own in s_own.values())
+            if not src_everywhere:
+                why = "source not replicated on dst devices"
+            else:
+                return ReshardPlan("local_slice", shape, itemsize, 0,
+                                   total, src_dim=None, dst_dim=j,
+                                   nparts=p, ranks=dst_order)
+    elif moved == 0:
         # same placement under a different sharding object: device_put is
         # a zero-copy relabel
         return fallback("placement-equal", moved=0)
-    return fallback("no sharded dims on either side", moved)
+    else:
+        why = "no sharded dims on either side"
+    # the single-collective fast paths passed: the general chain covers
+    # multi-axis repartitions, mesh-axis transposes and partial
+    # replication over a common mixed-radix refinement
+    ch = _try_chain(shape, itemsize, s_grid, s_own, d_grid, d_own, total,
+                    chunk_target)
+    if ch is not None:
+        return ch
+    return fallback(why, moved)
 
 
 def plan_reshard(x, dst_sharding, *, src_sharding=None,
@@ -324,7 +759,15 @@ def plan_reshard(x, dst_sharding, *, src_sharding=None,
     if hasattr(x, "sharding"):
         shape = tuple(int(s) for s in x.shape)
         src_sharding = x.sharding
-        itemsize = int(np.dtype(x.dtype).itemsize)
+        try:
+            itemsize = int(np.dtype(x.dtype).itemsize)
+        except TypeError:
+            # extended dtypes (PRNG keys) have no numpy itemsize; the
+            # collective lowerings can't slice them anyway — plan the
+            # counted device_put directly (bytes in element units)
+            n = math.prod(shape) if shape else 1
+            return ReshardPlan("device_put", shape, 1, n, n,
+                               reason="extended dtype")
     else:
         shape = tuple(int(s) for s in x)
         if src_sharding is None or itemsize is None:
@@ -351,6 +794,47 @@ def _spec_for(dim, ndim, axis):
     if dim is None:
         return P()
     return P(*[axis if d == dim else None for d in range(ndim)])
+
+
+def _a2a_chunked(x, axis, split_dim, concat_dim, p, chunk_axis, nchunks):
+    """Tiled all_to_all, chunked so one staging piece stays bounded.
+    Chunking along the split dim pre-slices so each chunk's tiled
+    exchange lands every rank the k-th contiguous slice of ITS dst block
+    — plain chunking along the split dim would interleave ranks."""
+    if nchunks <= 1:
+        return pall_to_all(x, axis, split_dim=split_dim,
+                           concat_dim=concat_dim)
+    if chunk_axis == split_dim:
+        jp = x.shape[split_dim] // p
+        step = jp // nchunks
+        outs = []
+        for k in range(nchunks):
+            piece = jnp.concatenate(
+                [lax.slice_in_dim(x, r * jp + k * step,
+                                  r * jp + (k + 1) * step,
+                                  axis=split_dim)
+                 for r in range(p)], axis=split_dim)
+            outs.append(pall_to_all(piece, axis, split_dim=split_dim,
+                                    concat_dim=concat_dim))
+        return jnp.concatenate(outs, axis=split_dim)
+    step = x.shape[chunk_axis] // nchunks
+    outs = [pall_to_all(
+        lax.slice_in_dim(x, k * step, (k + 1) * step, axis=chunk_axis),
+        axis, split_dim=split_dim, concat_dim=concat_dim)
+        for k in range(nchunks)]
+    return jnp.concatenate(outs, axis=chunk_axis)
+
+
+def _gather_chunked(x, axis, dim, chunk_axis, nchunks):
+    """Tiled all_gather along ``dim``, chunked along ``chunk_axis``."""
+    if nchunks <= 1:
+        return pgather(x, axis, tiled=True, dim=dim)
+    step = x.shape[chunk_axis] // nchunks
+    outs = [pgather(
+        lax.slice_in_dim(x, k * step, (k + 1) * step, axis=chunk_axis),
+        axis, tiled=True, dim=dim)
+        for k in range(nchunks)]
+    return jnp.concatenate(outs, axis=chunk_axis)
 
 
 @functools.lru_cache(maxsize=512)
@@ -384,42 +868,10 @@ def _collective_jit(mesh, strategy, ndim, src_dim, dst_dim, p,
             return _pc.ring_all_gather(x, axis, dim=src_dim,
                                        interpret=interp)
         if strategy == "all_to_all":
-            if nchunks <= 1:
-                return pall_to_all(x, axis, split_dim=dst_dim,
-                                   concat_dim=src_dim)
-            if chunk_axis == dst_dim:
-                # pre-slice so each chunk's tiled all_to_all lands every
-                # rank the k-th contiguous slice of ITS dst block — plain
-                # chunking along the split dim would interleave ranks
-                jp = x.shape[dst_dim] // p
-                step = jp // nchunks
-                outs = []
-                for k in range(nchunks):
-                    piece = jnp.concatenate(
-                        [lax.slice_in_dim(x, r * jp + k * step,
-                                          r * jp + (k + 1) * step,
-                                          axis=dst_dim)
-                         for r in range(p)], axis=dst_dim)
-                    outs.append(pall_to_all(piece, axis, split_dim=dst_dim,
-                                            concat_dim=src_dim))
-                return jnp.concatenate(outs, axis=dst_dim)
-            step = x.shape[chunk_axis] // nchunks
-            outs = [pall_to_all(
-                lax.slice_in_dim(x, k * step, (k + 1) * step,
-                                 axis=chunk_axis),
-                axis, split_dim=dst_dim, concat_dim=src_dim)
-                for k in range(nchunks)]
-            return jnp.concatenate(outs, axis=chunk_axis)
+            return _a2a_chunked(x, axis, dst_dim, src_dim, p, chunk_axis,
+                                nchunks)
         if strategy == "all_gather":
-            if nchunks <= 1:
-                return pgather(x, axis, tiled=True, dim=src_dim)
-            step = x.shape[chunk_axis] // nchunks
-            outs = [pgather(
-                lax.slice_in_dim(x, k * step, (k + 1) * step,
-                                 axis=chunk_axis),
-                axis, tiled=True, dim=src_dim)
-                for k in range(nchunks)]
-            return jnp.concatenate(outs, axis=chunk_axis)
+            return _gather_chunked(x, axis, src_dim, chunk_axis, nchunks)
         # local_slice: replicated -> sharded, zero communication
         r = lax.axis_index(axis)
         blk = x.shape[dst_dim] // p
@@ -440,6 +892,111 @@ def _run_collective(x, dst_sharding, plan: ReshardPlan, rdma=None):
     if y.sharding != dst_sharding:
         # equivalent placement under the caller's sharding object —
         # zero-copy relabel
+        y = jax.device_put(y, dst_sharding)
+    return y
+
+
+def _comp_spec(comp, ndim):
+    """PartitionSpec from per-dim mesh-axis composites (indices into the
+    refined mesh's ``d{i}`` axis names, major→minor)."""
+    entries = []
+    for d in range(ndim):
+        c = comp[d] if d < len(comp) else ()
+        if not c:
+            entries.append(None)
+        elif len(c) == 1:
+            entries.append(f"d{c[0]}")
+        else:
+            entries.append(tuple(f"d{m}" for m in c))
+    return P(*entries)
+
+
+@functools.lru_cache(maxsize=512)
+def _chain_jit(mesh, ndim, src_comp, dst_comp, steps, rdma=None):
+    """ONE compiled shard_map program running a planned per-axis
+    collective chain over the refined device mesh — the general lowering
+    (arXiv 2112.01075's per-axis decomposition).  With ``rdma`` set the
+    a2a/gather steps ride the Pallas RDMA ring kernels with
+    mesh-coordinate device ids (``mesh_axes``) when the mesh is
+    multi-axis; interpret mode demotes multi-axis arming to the lax
+    fallback inside the kernel, so CPU runs stay correct."""
+    _tm.count("jit.builds", fn="reshard_chain")
+    # cold path: lru-miss body, once per distinct planned chain
+    _tm.event("jit", "build", fn="reshard_chain",  # dalint: disable=DAL003
+              steps=len(steps), rdma=str(rdma))
+    in_spec = _comp_spec(src_comp, ndim)
+    out_spec = _comp_spec(dst_comp, ndim)
+    names = mesh.axis_names
+    mesh_axes = tuple(names) if len(names) > 1 else None
+
+    def kernel(x):
+        from ..ops import pallas_collectives as _pc
+        for kind, m, q, i, j, ca, nc in (s[:7] for s in steps):
+            name = f"d{m}"
+            if kind == "a2a":
+                if rdma:
+                    x = _pc.ring_all_to_all(
+                        x, name, split_dim=j, concat_dim=i,
+                        interpret=rdma == "interpret",
+                        mesh_axes=mesh_axes)
+                else:
+                    x = _a2a_chunked(x, name, j, i, q,
+                                     ca if ca >= 0 else None, nc)
+            elif kind == "gather":
+                if rdma:
+                    x = _pc.ring_all_gather(
+                        x, name, dim=i, interpret=rdma == "interpret",
+                        mesh_axes=mesh_axes)
+                else:
+                    x = _gather_chunked(x, name, i,
+                                        ca if ca >= 0 else None, nc)
+            else:                            # slice: local, no comm
+                r = lax.axis_index(name)
+                blk = x.shape[j] // q
+                x = lax.dynamic_slice_in_dim(x, r * blk, blk, axis=j)
+        return x
+
+    # composite specs + optional pallas_call inside: opt out of the
+    # replication check (multi-axis inference has no rule for either)
+    return jax.jit(shard_map_compat(kernel, mesh, in_spec, out_spec,
+                                    check=False))
+
+
+@functools.lru_cache(maxsize=256)
+def _pad_jit(mesh, src_comp, shape, pad_shape):
+    """Compiled ceil-pad: grow each uneven dim to its even analog under
+    the same placement — ceil cuts put every pad byte on the trailing
+    shard, so nothing crosses a device."""
+    _tm.count("jit.builds", fn="reshard_pad")
+    widths = tuple((0, p - s) for s, p in zip(shape, pad_shape))
+    out = NamedSharding(mesh, _comp_spec(src_comp, len(shape)))
+    return jax.jit(lambda x: jnp.pad(x, widths), out_shardings=out)
+
+
+@functools.lru_cache(maxsize=256)
+def _slice_back_jit(dst_sharding, shape):
+    """Compiled slice from the even analog back to the logical extent,
+    placed under the caller's (ceil-uneven) destination sharding."""
+    _tm.count("jit.builds", fn="reshard_slice")
+    idx = tuple(slice(0, s) for s in shape)
+    return jax.jit(lambda y: y[idx], out_shardings=dst_sharding)
+
+
+def _run_chain(x, dst_sharding, plan: ReshardPlan, rdma=None):
+    mesh = L.mesh_for(list(plan.ranks), plan.mesh_shape)
+    ndim = len(plan.shape)
+    if plan.pad_shape:
+        x = _pad_jit(mesh, plan.src_comp, plan.shape, plan.pad_shape)(x)
+    fn = _chain_jit(mesh, ndim, plan.src_comp, plan.dst_comp, plan.steps,
+                    rdma)
+    y = fn(x)
+    if plan.pad_shape:
+        return _slice_back_jit(dst_sharding, plan.shape)(y)
+    if plan.strategy == "gather_put":
+        # restrict the now-replicated buffer to the survivor subset —
+        # comm-free: every destination device already holds the bytes
+        return _device_put_path(y, dst_sharding)
+    if y.sharding != dst_sharding:
         y = jax.device_put(y, dst_sharding)
     return y
 
@@ -471,22 +1028,53 @@ def _device_put_path(x, dst_sharding):
     return jax.device_put(x, dst_sharding)
 
 
+def _fallback_reason(reason: str) -> str:
+    """Canonical residue class for the ``reason=`` label on
+    ``reshard.collective_fallbacks`` — why a move still falls back
+    (uneven | multi_axis | device_set | dtype | shape)."""
+    r = reason.lower()
+    if "uneven" in r or "divisible" in r:
+        return "uneven"
+    if "device set" in r or "not replicated on dst" in r:
+        return "device_set"
+    if "dtype" in r:
+        return "dtype"
+    if "multi-dim" in r or "incompatible" in r or "rank order" in r \
+            or "replicated" in r:
+        return "multi_axis"
+    return "shape"
+
+
 def reshard(x, dst_sharding, *, op: str = "reshard",
             plan: ReshardPlan | None = None):
     """Move ``x`` onto ``dst_sharding`` via the planned strategy.
 
     The single funnel for cross-sharding data movement (DAL007): plans
     are cached per layout pair, divisible single-axis repartitions run as
-    one compiled chunked-collective program, everything else takes the
-    ``device_put`` path.  Telemetry: a ``reshard`` span labeled with the
-    strategy, and comm bytes = the plan's *moved* bytes (what must cross
-    a device boundary), not the whole array."""
+    one compiled chunked-collective program, the general case runs the
+    per-axis collective chain over the refined mesh, and the residue
+    takes the ``device_put`` path (counted, with a canonical ``reason=``
+    label).  Telemetry: a ``reshard`` span labeled with the strategy and
+    the plan's ``intra_bytes``/``cross_bytes`` domain split, and comm
+    bytes = the plan's *moved* bytes (what must cross a device
+    boundary), not the whole array."""
     if getattr(x, "sharding", None) == dst_sharding:
         return x
     if plan is None:
         plan = plan_reshard(x, dst_sharding)
     if plan.strategy == "noop":
         return x
+    if plan.collective:
+        try:
+            ext = jax.dtypes.issubdtype(getattr(x, "dtype", None),
+                                        jax.dtypes.extended)
+        except Exception:
+            ext = False
+        if ext:
+            # extended dtypes (PRNG key arrays) have no collective
+            # lowering — planned from shardings alone, gated on dtype here
+            plan = dataclasses.replace(plan, strategy="device_put",
+                                       reason="extended dtype")
     # RDMA dispatch decided eagerly so the compiled program is keyed on
     # it (flipping DA_TPU_RDMA re-jits) and the span says which path ran
     rdma = None
@@ -495,7 +1083,13 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
     autotune_key = ""
     dispatch_key = ""
     dispatch_src = ""
-    if plan.collective and plan.strategy in ("all_to_all", "all_gather"):
+    if plan.steps and any(s[0] != "slice" for s in plan.steps):
+        # chain steps ride the ring kernels when the platform arms them
+        # (mesh-coordinate addressing on multi-axis meshes); slices-only
+        # chains are local and need no dispatch decision
+        from ..ops import pallas_collectives as _pc
+        rdma = _pc.rdma_mode()
+    elif plan.collective and plan.strategy in ("all_to_all", "all_gather"):
         from ..ops import pallas_collectives as _pc
         rdma = _pc.rdma_mode()
         dtype_str = str(getattr(x, "dtype", "float32"))
@@ -527,7 +1121,11 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
                   shape=list(plan.shape),
                   dtype=str(getattr(x, "dtype", "float32")),
                   src_dim=plan.src_dim, dst_dim=plan.dst_dim,
-                  nparts=plan.nparts,
+                  nparts=plan.nparts, nsteps=len(plan.steps),
+                  # hierarchical-tier provenance: how many of the moved
+                  # bytes stay on fast intra-domain links vs cross the DCN
+                  intra_bytes=plan.intra_bytes,
+                  cross_bytes=plan.cross_bytes,
                   # analytic cost stamp (telemetry.perf): every byte
                   # read + rewritten through HBM, the plan's MOVED bytes
                   # crossing a device boundary over ICI, zero flops —
@@ -550,6 +1148,10 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
                 # regressions, not compiled-program memory use
                 local = plan.total_bytes // max(plan.nparts, 1)
                 piece = -(-local // max(plan.nchunks, 1))
+                if plan.staging_bytes:
+                    # chain: the planner pre-computed the worst step's
+                    # staging piece over the evolving local shape
+                    piece = plan.staging_bytes
                 if rdma and plan.strategy == "all_to_all":
                     # the RDMA ring lands chunk DMAs at their final
                     # output offsets; what stages per device is one
@@ -557,7 +1159,10 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
                     piece = min(piece,
                                 -(-local // max(rdma_chunks, 1)))
                 with _tm.memory.staging(f"reshard.{plan.strategy}", piece):
-                    out = _run_collective(x, dst_sharding, plan, rdma)
+                    if plan.steps:
+                        out = _run_chain(x, dst_sharding, plan, rdma)
+                    else:
+                        out = _run_collective(x, dst_sharding, plan, rdma)
                 if _tm.enabled():
                     _tm.record_comm("reshard", plan.moved_bytes, op=op,
                                     strategy=plan.strategy,
@@ -567,13 +1172,19 @@ def reshard(x, dst_sharding, *, op: str = "reshard",
             except Exception as e:
                 # the compiled path must never cost correctness; fall
                 # through to device_put, loudly once per signature
-                _tm.count("reshard.collective_fallbacks")
+                _tm.count("reshard.collective_fallbacks", reason="runtime")
                 from ..utils.debug import warn_once
                 warn_once(
                     f"reshard:{plan.strategy}:{type(e).__name__}",
                     f"reshard: compiled {plan.strategy} lowering failed "
                     f"({type(e).__name__}: {e}); falling back to "
                     f"device_put")
+        if plan.strategy == "device_put" and plan.moved_bytes:
+            # the residue the advisor targets: why does this move still
+            # fall back?  (placement-equal relabels move nothing and are
+            # not a residue)
+            _tm.count("reshard.collective_fallbacks",
+                      reason=_fallback_reason(plan.reason))
         if _tm.enabled():
             _tm.record_comm("reshard", plan.moved_bytes, op=op,
                             strategy="device_put", shape=list(plan.shape))

@@ -24,8 +24,12 @@ Semantics:
   disables the real-signal half entirely.
 - ``shrink()`` — re-lay-out every registered DArray that touches a down
   rank onto the survivors.  Data movement is ``parallel.reshard`` with a
-  device-set-changing plan (the planner's ``device_put`` fallback — the
-  correct strategy: survivors must receive bytes they never held).  The
+  device-set-changing plan: even survivor layouts lower through the
+  general chain, and uneven survivor counts (where ``sharding_for``
+  leaves the dim replicated) take the planner's ``gather_put`` strategy
+  — a collective chain-gather on the source mesh followed by a comm-free
+  restriction onto the survivors — with ``device_put`` only as the
+  counted last resort.  The
   DArray mutates **in place**: same id, same registry entry, new
   pids/indices/cuts/sharding/buffer — and the HBM ledger re-tracks the
   buffer under the same owner, so per-device gauges show the downed

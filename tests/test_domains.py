@@ -231,6 +231,40 @@ def test_slow_link_delay_is_seeded_and_bounded():
     assert 0.25 <= d < 0.5                   # [0.5, 1.0) * hang_s
 
 
+def test_cross_domain_reshard_survives_seeded_slow_link(rng):
+    # the hierarchical-tier chaos gate: a seeded slow_link firing at the
+    # reshard chaos site stalls (never kills) a CROSS-domain collective
+    # chain — the mesh-axis transpose must still lower through
+    # collectives (no silent device_put demotion) and land bit-identical
+    # to the oracle, with the firing on the chaos record
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributedarrays_tpu import layout as L
+    from distributedarrays_tpu.parallel import reshard as R
+
+    domains.configure("4,4")
+    faults.configure(seed=1234, plan=[
+        {"site": "reshard.chunk", "action": "slow_link", "at": 1,
+         "count": -1, "hang_s": 0.01}])
+    A = rng.standard_normal((48, 48)).astype(np.float32)
+    mesh = L.mesh_for(list(range(8)), (4, 2))
+    src = NamedSharding(mesh, P("d0", "d1"))
+    dst = NamedSharding(mesh, P("d1", "d0"))
+    x = jax.device_put(A, src)
+    plan = R.plan_reshard(x, dst)
+    # the transpose touches the major mesh axis, so its gather/a2a
+    # sub-groups span the 4|4 domain boundary: a genuine DCN-path move
+    assert plan.strategy == "chain" and plan.cross_bytes > 0
+    h0 = len(faults.history())
+    y = R.reshard(x, dst)
+    fired = [f for f in faults.history()[h0:]
+             if f["action"] == "slow_link"]
+    assert fired and fired[0]["site"] == "reshard.chunk"
+    assert y.sharding.is_equivalent_to(dst, y.ndim)
+    np.testing.assert_array_equal(
+        np.asarray(y), np.asarray(jax.device_put(A, dst)))
+
+
 # ---------------------------------------------------------------------------
 # quorum_assess + elastic integration
 # ---------------------------------------------------------------------------

@@ -523,3 +523,77 @@ def test_disabled_telemetry_subprocess():
                          cwd=os.path.dirname(os.path.dirname(__file__)))
     assert out.returncode == 0, out.stderr
     assert "OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# mesh-coordinate addressing (PR 19: per-axis sub-rings on 2-D meshes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("grid,axis_i", [((4, 2), 0), ((4, 2), 1),
+                                         ((2, 2, 2), 1)])
+def test_ring_all_gather_mesh_axes_oracle(grid, axis_i, rng):
+    # armed along one axis of a multi-axis mesh, the kernel must equal
+    # the per-axis lax.all_gather (on CPU the interpret demotion routes
+    # through the lax fallback — the dispatch seam under test)
+    mesh = L.mesh_for(list(range(int(np.prod(grid)))), grid)
+    names = mesh.axis_names
+    ax = names[axis_i]
+    ndim = len(grid)
+    x = _ints(rng, tuple(8 * g for g in grid))
+    spec = P(*names)
+    out = P(*[None if i == axis_i else names[i] for i in range(ndim)])
+    y1 = run_spmd(lambda a: PC.ring_all_gather(
+        a, ax, dim=axis_i, interpret=True, mesh_axes=names),
+        mesh, (spec,), out)(x)
+    y2 = run_spmd(lambda a: lax.all_gather(a, ax, axis=axis_i, tiled=True),
+                  mesh, (spec,), out)(x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_ring_all_to_all_mesh_axes_oracle(rng):
+    grid = (4, 2)
+    mesh = L.mesh_for(list(range(8)), grid)
+    names = mesh.axis_names
+    x = _ints(rng, (32, 16))
+    spec = P("d0", "d1")
+    y1 = run_spmd(lambda a: PC.ring_all_to_all(
+        a, "d0", split_dim=1, concat_dim=0, interpret=True,
+        mesh_axes=names), mesh, (spec,), P(None, ("d1", "d0")))(x)
+    y2 = run_spmd(lambda a: lax.all_to_all(
+        a, "d0", split_axis=1, concat_axis=0, tiled=True),
+        mesh, (spec,), P(None, ("d1", "d0")))(x)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+def test_arm_mesh_validates_and_demotes():
+    # unknown armed axis fails loudly
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        PC._arm_mesh("compiled", "bogus", ("d0", "d1"))
+    # 1-D (or omitted) meshes keep logical addressing
+    assert PC._arm_mesh("compiled", "d0", None) == ("compiled", None)
+    assert PC._arm_mesh("compiled", "d0", ("d0",)) == ("compiled", None)
+    # multi-axis + interpret demotes to the lax fallback (interpret-mode
+    # DMA only discharges on 1-D meshes); compiled keeps MESH addressing
+    assert PC._arm_mesh("interpret", "d1", ("d0", "d1")) == (None, None)
+    assert PC._arm_mesh("compiled", "d1", ("d0", "d1")) == \
+        ("compiled", ("d0", "d1"))
+
+
+def test_fused_matmul_helpers_accept_mesh_axes(rng):
+    # the collective_matmul helpers forward mesh_axes to the fused
+    # kernels; on a multi-axis CPU mesh the interpret demotion keeps the
+    # lax ring and results stay exact
+    grid = (4, 2)
+    mesh = L.mesh_for(list(range(8)), grid)
+    names = mesh.axis_names
+    a = _ints(rng, (32, 16))
+    b = _ints(rng, (32, 16))
+    specs = (P("d0", None), P("d0", None))
+    out = P("d0", None)
+    y1 = run_spmd(lambda aa, bb: allgather_matmul_rhs(
+        aa, bb, "d0", rdma=True, interpret=True, mesh_axes=names),
+        mesh, specs, out)(a, b)
+    y2 = run_spmd(lambda aa, bb: allgather_matmul_rhs(aa, bb, "d0"),
+                  mesh, specs, out)(a, b)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)

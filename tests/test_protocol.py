@@ -239,3 +239,56 @@ def test_cli_verify_protocols_fails_closed_on_budget():
         capture_output=True, text=True, cwd=REPO, timeout=300)
     assert r.returncode == 1
     assert "state-budget" in r.stdout or "FAILED" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis variants (PR 19: per-axis sub-rings on 2-D/3-D meshes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_shape,axis", list(protocol.DEFAULT_MESHES))
+def test_mesh_schedule_ring_all_gather_verifies(mesh_shape, axis):
+    p = mesh_shape[axis]
+    sched = rs.build("ring_all_gather", p, 2)
+    res = protocol.check_mesh_schedule(sched, mesh_shape, axis)
+    assert res.ok, (mesh_shape, axis, res.kind, res.detail)
+
+
+def test_verify_mesh_protocols_end_to_end():
+    # every shipped schedule x every (mesh, axis) variant verifies, and
+    # every mesh-geometry mutant is REFUTED (not budget-skipped)
+    rep = protocol.verify_mesh_protocols()
+    assert rep["ok"]
+    assert all(r.ok for r in rep["kernels"])
+    assert len(rep["kernels"]) >= len(protocol.KERNEL_NAMES) * \
+        len(protocol.DEFAULT_MESHES)
+    assert rep["mutants"], "mesh mutant harness must run"
+    for m in rep["mutants"]:
+        assert not m.ok and m.kind != "state-budget", m.name
+        assert m.mutation in protocol.MESH_MUTATIONS
+
+
+@pytest.mark.parametrize("mutation", protocol.MESH_MUTATIONS)
+def test_mesh_mutant_addr_leaves_the_subring(mutation):
+    # the mutant address computations really do land outside the armed
+    # sub-ring for some (rank, pos) — the property the isolation check
+    # refutes them by
+    mesh_shape, axis = (2, 4), 1
+    addr = protocol.mesh_mutant_addr(mesh_shape, axis, mutation)
+    escaped = False
+    for ring in rs.mesh_subrings(mesh_shape, axis):
+        for rank in ring:
+            for pos in range(len(ring)):
+                if addr(rank, pos) not in ring:
+                    escaped = True
+    assert escaped
+
+
+def test_cli_verify_protocols_mesh_flag():
+    r = subprocess.run(
+        [sys.executable, "-m", "distributedarrays_tpu.analysis",
+         "verify-protocols", "--ps", "2", "--depths", "1", "--mesh",
+         "--quiet"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "protocol verification: OK" in r.stdout

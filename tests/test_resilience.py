@@ -23,6 +23,8 @@ from distributedarrays_tpu.parallel import spmd_mode as S
 from distributedarrays_tpu.resilience import elastic, faults, recovery
 from distributedarrays_tpu.telemetry import flight
 from distributedarrays_tpu.telemetry import memory as tmem
+from distributedarrays_tpu.telemetry.fixtures import \
+    telemetry_capture  # noqa: F401
 from distributedarrays_tpu.utils.checkpoint import CheckpointManager
 
 _HAS_FORK = hasattr(os, "fork")
@@ -309,6 +311,32 @@ def test_grow_leaves_untouched_custom_layouts_alone(rng):
     assert np.array_equal(np.asarray(custom), A)
     custom.close()
     full.close()
+
+
+def test_shrink_relayout_routes_through_general_lowering(
+        rng, telemetry_capture):
+    # the recovery re-layout is a PLANNED reshard, not a bare
+    # device_put: shrinking 8 -> 6 over a 40-row array leaves the
+    # survivor dim non-divisible (40 % 6 != 0), so the planner's
+    # gather_put strategy carries the move — witnessed by the
+    # recovery-time reshard span's strategy/dispatch labels
+    cap = telemetry_capture
+    A = rng.standard_normal((40, 8)).astype(np.float32)
+    d = dat.distribute(A)
+    m = elastic.manager()
+    m.mark_down(6)
+    m.mark_down(7)
+    res = m.shrink()
+    assert res["failed"] == []
+    spans = cap.spans("reshard")
+    assert spans, "shrink re-layout emitted no reshard span"
+    labels = [s.get("labels", {}) for s in spans]
+    assert "gather_put" in {lb.get("strategy") for lb in labels}, labels
+    # every recovery-time reshard span carries the dispatch label —
+    # proof the move went through the instrumented general lowering
+    assert all(lb.get("dispatch") in ("rdma", "xla") for lb in labels)
+    assert np.array_equal(np.asarray(d), A)
+    d.close()
 
 
 def test_shrink_requires_survivors():
