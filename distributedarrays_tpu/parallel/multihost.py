@@ -32,7 +32,8 @@ from .. import telemetry as _tm
 __all__ = ["initialize", "global_mesh", "process_info", "sync_hosts",
            "host_local_slice", "gather_global", "heartbeat",
            "down_peer_processes", "quorum_assess",
-           "exchange_clock_offsets"]
+           "exchange_clock_offsets", "advertise_aggregator",
+           "aggregator_endpoint", "advertise_exporter"]
 
 
 def _init_timeout_kw(initialization_timeout_s: int | None) -> dict:
@@ -107,6 +108,58 @@ def _kv_client():
 
 _HB_PREFIX = "dat/heartbeat/"
 _CLOCK_PREFIX = "dat/clock/"
+_AGG_KEY = "dat/telemetry/agg"
+_EXPORTER_PREFIX = "dat/telemetry/exporter/"
+
+
+def advertise_aggregator(url: str) -> bool:
+    """Publish the live-telemetry aggregator's URL to the coordination
+    KV so every host's streaming exporter (:mod:`telemetry.stream`) can
+    discover it without per-host configuration — the same KV the
+    heartbeat rides.  Returns False (no-op) single-process."""
+    client = _kv_client()
+    if client is None:
+        return False
+    try:  # pragma: no cover — needs a real multi-controller job
+        client.key_value_set(_AGG_KEY, str(url), allow_overwrite=True)
+        return True
+    except Exception:  # pragma: no cover
+        return False
+
+
+def aggregator_endpoint() -> str | None:
+    """The advertised aggregator URL from the coordination KV, or None
+    (single-process, nothing advertised, or client unavailable) — the
+    exporter's discovery fallback when ``DA_TPU_STREAM_AGG`` is unset."""
+    client = _kv_client()
+    if client is None:
+        return None
+    try:  # pragma: no cover — needs a real multi-controller job
+        raw = client.key_value_try_get(_AGG_KEY)
+        return str(raw) if raw else None
+    except Exception:  # pragma: no cover
+        return None
+
+
+def advertise_exporter() -> bool:
+    """Register this process's armed streaming exporter in the KV
+    (``dat/telemetry/exporter/<idx>`` -> ``"<host>:<pid> <epoch>"``) so
+    an operator can enumerate which hosts are publishing to the live
+    plane.  Returns False (no-op) single-process or when unarmed."""
+    client = _kv_client()
+    if client is None:
+        return False
+    try:  # pragma: no cover — needs a real multi-controller job
+        from ..telemetry import stream as _stream
+        if not _stream.armed():
+            return False
+        client.key_value_set(
+            f"{_EXPORTER_PREFIX}{jax.process_index()}",
+            f"{_tm.core._HOST}:{os.getpid()} {time.time():.3f}",
+            allow_overwrite=True)
+        return True
+    except Exception:  # pragma: no cover
+        return False
 
 
 def heartbeat() -> bool:

@@ -43,6 +43,7 @@ from typing import Any, Callable
 
 from .. import core
 from .. import telemetry as _tm
+from ..telemetry import stream as _tstream
 from ..resilience import elastic, faults as _fl, recovery
 from .admission import AdmissionController
 from .batching import BatchQueue, Request, payload_key
@@ -342,7 +343,12 @@ class Server:
         # admission controller.  The unlabeled gauge is the global shed
         # signal; the labeled one is the per-endpoint window (its own
         # maxlen per ServeConfig/register)
-        _tm.set_gauge("serve.request_p99_s", self._admission.latency.p99())
+        p99 = self._admission.latency.p99()
+        _tm.set_gauge("serve.request_p99_s", p99)
+        # live plane: every p99 update reaches the aggregator's burn
+        # windows with its own wall stamp, not just the last value per
+        # exporter tick (a single is-None check when no exporter is armed)
+        _tstream.note("serve.request_p99_s", p99)
         if endpoint is not None:
             _tm.set_gauge(
                 "serve.request_p99_s",

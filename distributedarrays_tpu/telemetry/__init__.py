@@ -54,6 +54,8 @@ from . import tracing
 from . import cluster
 from . import alerts
 from . import advisor
+from . import stream
+from . import agg
 from .memory import leak_census
 from .flight import postmortem, record_crash
 from .cluster import merge_journals, reconstruct_incidents
@@ -72,7 +74,7 @@ __all__ = [
     "current_trace_ids", "bind_trace_ids", "record_external_span",
     "to_perfetto", "to_prometheus",
     "memory", "flight", "perf", "regress", "tracing", "cluster", "alerts",
-    "advisor",
+    "advisor", "stream", "agg",
     "leak_census", "postmortem", "record_crash",
     "merge_journals", "reconstruct_incidents",
     "AlertRule", "AlertManager", "default_rules",
@@ -83,3 +85,6 @@ __all__ = [
 # import-time auto-install pattern as flight's SIGUSR1 handler; with
 # DA_TPU_TELEMETRY=0 or no interval this is a no-op
 alerts._maybe_autostart()
+# arm the live-plane streaming exporter when DA_TPU_STREAM_AGG is set
+# (same pattern); no-op when unset or telemetry is disabled
+stream._maybe_autostart()

@@ -16,6 +16,14 @@ Usage::
     python -m distributedarrays_tpu.telemetry incident RUN.jsonl [RUN2.jsonl
         ...] [--bundles DIR_OR_FILE ...] [--json] [--trace OUT.json]
         [--strict-bundles]
+    python -m distributedarrays_tpu.telemetry flame RUN.jsonl [--min-frac F]
+    python -m distributedarrays_tpu.telemetry flame --url http://AGG:PORT
+    python -m distributedarrays_tpu.telemetry top --url http://AGG:PORT
+        [--interval S] [--once] [--json]
+    python -m distributedarrays_tpu.telemetry agg [--port 9900]
+        [--p99-slo S] [--duration S]
+    python -m distributedarrays_tpu.telemetry stream RUN.jsonl
+        --agg http://AGG:PORT [--interval S] [--duration S]
     python -m distributedarrays_tpu.telemetry RUN.jsonl [--json]   # legacy
 
 ``summarize`` prints event counts by category (grouped per host when the
@@ -38,8 +46,18 @@ reconstructs ordered incident reports from them plus any flight bundles
 (``telemetry/cluster.py``) — ``--trace`` additionally writes the merged
 Perfetto trace with incident flow arrows, and ``--strict-bundles``
 exits 1 if any bundle or recovery attempt could not be attributed (the
-CI orphan gate).  ``-`` reads stdin.  The first form without a
-subcommand is the PR-1 interface and behaves exactly like ``summarize``.
+CI orphan gate).  The live-plane commands (``docs/telemetry.md``):
+``flame`` renders collapsed-stack flame format (Brendan Gregg style,
+feed to flamegraph.pl or speedscope) from a journal's span self-times —
+or, with ``--url``, the continuous sampling profile of a live
+aggregator; ``top`` is the real-time cluster dashboard refreshing from
+an aggregator's ``/snapshot``; ``agg`` runs the streaming aggregator
+(POST ``/ingest``, Prometheus ``/metrics``, ``/healthz``,
+``/snapshot``, ``/flame``, chunked Perfetto ``/trace``); ``stream`` is
+the out-of-process exporter, tailing a journal file (rotation-aware)
+and shipping bounded delta frames to an aggregator.  ``-`` reads
+stdin.  The first form without a subcommand is the PR-1 interface and
+behaves exactly like ``summarize``.
 
 A missing or empty journal exits with a one-line message and status 2
 instead of a traceback.  At the size cap journals now ROTATE to
@@ -455,11 +473,209 @@ def _cmd_postmortem(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# live plane: flame / top / agg / stream
+# ---------------------------------------------------------------------------
+
+
+def _http_get(url: str, path: str, timeout: float = 5.0) -> bytes:
+    import urllib.request
+    base = url.rstrip("/")
+    if not base.startswith("http://") and not base.startswith("https://"):
+        base = "http://" + base
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read()
+
+
+def _cmd_flame(args) -> int:
+    from . import stream as _stream
+    if args.url:
+        try:
+            text = _http_get(args.url, "/flame").decode()
+        except OSError as e:
+            print(f"cannot reach aggregator {args.url}: {e}",
+                  file=sys.stderr)
+            return 2
+        _write_out(text if text.endswith("\n") or not text else text + "\n",
+                   args.out)
+        return 0
+    if not args.journal:
+        print("flame: need a journal path or --url", file=sys.stderr)
+        return 2
+    events = _read_events_checked(args.journal)
+    counts, stats = _stream.collapsed_from_events(events)
+    if args.json:
+        _write_out(json.dumps({"counts": counts, "stats": stats},
+                              indent=2, sort_keys=True) + "\n", args.out)
+    else:
+        text = _stream.collapsed_lines(counts)
+        _write_out(text + "\n" if text else "", args.out)
+        print(f"flame: {stats['spans']} spans, "
+              f"{stats['attributed_s']:.3f}s attributed / "
+              f"{stats['wall_s']:.3f}s wall "
+              f"({stats['attributed_frac']:.1%})", file=sys.stderr)
+    if args.min_frac and stats["attributed_frac"] < args.min_frac:
+        print(f"flame attribution {stats['attributed_frac']:.1%} below "
+              f"--min-frac {args.min_frac:.1%}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{float(v) * 1e3:8.1f}"
+
+
+def _render_top(snap: dict) -> str:
+    out = io.StringIO()
+    hosts = snap.get("hosts") or {}
+    out.write(f"da-tpu top — {len(hosts)} host(s), "
+              f"uptime {snap.get('uptime_s', 0)}s, "
+              f"{snap.get('frames_ingested', 0)} frames ingested\n\n")
+    hdr = (f"{'HOST':<20} {'AGE':>5} {'HBM LIVE':>10} {'PEAK':>10} "
+           f"{'DEV':>4} {'P99 ms':>8} {'SHED':>6} {'STEP s':>8} "
+           f"{'DROP':>5} {'EVTS':>7}")
+    out.write(hdr + "\n")
+    for key in sorted(hosts):
+        h = hosts[key]
+        age = h.get("age_s")
+        age_s = "-" if age is None else f"{age:.1f}"
+        if h.get("stale"):
+            age_s += "!"
+        shed = h.get("shed_fraction")
+        step = h.get("train_step_s")
+        dev = h.get("live_devices")
+        drops = (int(h.get("dropped_frames") or 0)
+                 + int(h.get("lost_frames") or 0))
+        shed_s = f"{shed:.1%}" if shed is not None else "-"
+        step_s = f"{step:.3f}" if step is not None else "-"
+        dev_s = str(dev) if dev is not None else "-"
+        out.write(
+            f"{key:<20} {age_s:>5} "
+            f"{_fmt_bytes(h.get('hbm_live_bytes') or 0):>10} "
+            f"{_fmt_bytes(h.get('hbm_peak_bytes') or 0):>10} "
+            f"{dev_s:>4} {_fmt_ms(h.get('serve_p99_s')):>8} "
+            f"{shed_s:>6} {step_s:>8} "
+            f"{drops:>5} {h.get('events', 0):>7}\n")
+    alerts = snap.get("alerts") or []
+    out.write(f"\nalerts firing: "
+              f"{', '.join(sorted(alerts)) if alerts else 'none'}\n")
+    incidents = snap.get("incidents") or []
+    if incidents:
+        out.write(f"open incidents: {', '.join(incidents)}\n")
+    return out.getvalue()
+
+
+def _cmd_top(args) -> int:
+    import time as _time
+
+    def _snap():
+        return json.loads(_http_get(args.url, "/snapshot").decode())
+
+    try:
+        snap = _snap()
+    except OSError as e:
+        print(f"cannot reach aggregator {args.url}: {e}", file=sys.stderr)
+        return 2
+    except ValueError:
+        print(f"aggregator {args.url} returned non-JSON snapshot "
+              f"(telemetry disabled on the aggregator?)", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(snap, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    if args.once:
+        sys.stdout.write(_render_top(snap))
+        return 0
+    try:
+        while True:
+            # home + clear-to-end keeps the repaint flicker-free without
+            # pulling in curses
+            sys.stdout.write("\x1b[H\x1b[2J" + _render_top(snap))
+            sys.stdout.flush()
+            _time.sleep(max(0.1, args.interval))
+            try:
+                snap = _snap()
+            except (OSError, ValueError):
+                sys.stdout.write("\n(aggregator unreachable — retrying)\n")
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_agg(args) -> int:
+    import time as _time
+    from . import core as _core
+    from . import agg as _agg
+    if not _core.enabled():
+        print("telemetry is disabled (DA_TPU_TELEMETRY=0): "
+              "aggregator refusing to start", file=sys.stderr)
+        return 2
+    srv = _agg.serve(host=args.host, port=args.port,
+                     advertise=not args.no_advertise,
+                     eval_interval_s=args.eval_interval,
+                     p99_slo_s=args.p99_slo)
+    print(f"aggregator listening on {srv.url}", file=sys.stderr)
+    print(f"  POST {srv.url}/ingest     (exporter frames)", file=sys.stderr)
+    print(f"  GET  {srv.url}/metrics    (Prometheus scrape)",
+          file=sys.stderr)
+    print(f"  GET  {srv.url}/healthz /snapshot /flame /trace",
+          file=sys.stderr)
+    try:
+        if args.duration:
+            _time.sleep(args.duration)
+        else:
+            while True:
+                _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+def _cmd_stream(args) -> int:
+    import time as _time
+    from . import core as _core
+    from . import stream as _stream
+    if not _core.enabled():
+        print("telemetry is disabled (DA_TPU_TELEMETRY=0): "
+              "exporter refusing to start", file=sys.stderr)
+        return 2
+    if not os.path.exists(args.journal):
+        print(f"cannot read journal: {args.journal}", file=sys.stderr)
+        return 2
+    exp = _stream.StreamExporter(args.agg, interval_s=args.interval,
+                                 ring_frames=args.ring,
+                                 journal=args.journal)
+    exp.start()
+    print(f"streaming {args.journal} -> {args.agg} "
+          f"every {args.interval}s (ring {args.ring} frames)",
+          file=sys.stderr)
+    try:
+        if args.duration:
+            _time.sleep(args.duration)
+        else:
+            while True:
+                _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        exp.stop()
+    st = exp.stats_dict()
+    print(f"stream: {st['frames_sent']} frames sent, "
+          f"{st['frames_dropped']} dropped, "
+          f"{st['events_shipped']} events shipped, "
+          f"{st['events_dropped']} events dropped, "
+          f"{st['send_errors']} send errors", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] in ("summarize", "trace", "prom", "mem",
                             "postmortem", "doctor", "regress", "incident",
-                            "advise"):
+                            "advise", "flame", "top", "agg", "stream"):
         ap = argparse.ArgumentParser(
             prog="python -m distributedarrays_tpu.telemetry",
             description="Summarize or export a telemetry journal/report.")
@@ -574,6 +790,69 @@ def main(argv=None) -> int:
         p.add_argument("--json", action="store_true",
                        help="emit the incident report as JSON")
         p.set_defaults(fn=_cmd_incident)
+        p = sub.add_parser("flame",
+                           help="journal (or live aggregator) -> "
+                                "collapsed-stack flame format")
+        p.add_argument("journal", nargs="?", default=None,
+                       help="JSONL journal path ('-' = stdin); omit "
+                            "with --url")
+        p.add_argument("--url", default=None,
+                       help="fetch the live flame profile from an "
+                            "aggregator instead of a journal")
+        p.add_argument("-o", "--out", default=None,
+                       help="output path (default stdout)")
+        p.add_argument("--min-frac", type=float, default=0.0,
+                       help="exit 2 unless at least this fraction of "
+                            "wall time is attributed (CI gate; journal "
+                            "mode only)")
+        p.add_argument("--json", action="store_true",
+                       help="emit counts + attribution stats as JSON")
+        p.set_defaults(fn=_cmd_flame)
+        p = sub.add_parser("top",
+                           help="live terminal dashboard refreshing "
+                                "from an aggregator")
+        p.add_argument("--url", required=True,
+                       help="aggregator base URL (telemetry agg prints "
+                            "it)")
+        p.add_argument("--interval", type=float, default=1.0,
+                       help="refresh interval seconds (default 1)")
+        p.add_argument("--once", action="store_true",
+                       help="render one frame and exit (no screen "
+                            "clearing; scripts/tests)")
+        p.add_argument("--json", action="store_true",
+                       help="dump the raw snapshot JSON once and exit")
+        p.set_defaults(fn=_cmd_top)
+        p = sub.add_parser("agg",
+                           help="run the streaming aggregator "
+                                "(ingest/metrics/healthz/flame/trace)")
+        p.add_argument("--host", default="127.0.0.1",
+                       help="bind address (default 127.0.0.1)")
+        p.add_argument("--port", type=int, default=9900,
+                       help="bind port (default 9900; 0 = ephemeral)")
+        p.add_argument("--p99-slo", type=float, default=0.5,
+                       help="serve p99 SLO seconds for the live alert "
+                            "rules (default 0.5)")
+        p.add_argument("--eval-interval", type=float, default=0.5,
+                       help="alert evaluation interval seconds")
+        p.add_argument("--duration", type=float, default=0.0,
+                       help="exit after N seconds (0 = run until ^C)")
+        p.add_argument("--no-advertise", action="store_true",
+                       help="skip publishing the URL to the multihost "
+                            "coordination KV")
+        p.set_defaults(fn=_cmd_agg)
+        p = sub.add_parser("stream",
+                           help="external exporter: tail a journal file "
+                                "and stream frames to an aggregator")
+        p.add_argument("journal", help="JSONL journal path to tail")
+        p.add_argument("--agg", required=True,
+                       help="aggregator base URL")
+        p.add_argument("--interval", type=float, default=0.5,
+                       help="frame interval seconds (default 0.5)")
+        p.add_argument("--ring", type=int, default=256,
+                       help="bounded frame-ring capacity (default 256)")
+        p.add_argument("--duration", type=float, default=0.0,
+                       help="exit after N seconds (0 = run until ^C)")
+        p.set_defaults(fn=_cmd_stream)
         args = ap.parse_args(argv)
         try:
             return args.fn(args)

@@ -377,6 +377,15 @@ class _HealthSampler(threading.Thread):
             self.manager.evaluate()
         except Exception:
             pass                  # the sampler must never kill the host
+        # one sampler feeds journal, alerts, AND the live plane: when a
+        # streaming exporter is armed the tick's fields go out with the
+        # next frame (note_health is a single is-None check otherwise)
+        try:
+            from . import stream as _stream
+            _stream.note_health(dict(fields, t=round(
+                time.monotonic() - core._T0, 3)))
+        except Exception:
+            pass
 
     def run(self) -> None:  # pragma: no cover — exercised via ticks
         while not self._stop.wait(self.interval_s):

@@ -90,12 +90,17 @@ _incident_seq = 0
 _T0 = time.monotonic()
 
 # host identity stamped on every journal event (and postmortem bundle) so
-# multihost journals can be merged and re-grouped per host offline
-try:
-    import socket as _socket
-    _HOST = _socket.gethostname() or "unknown"
-except Exception:  # pragma: no cover
-    _HOST = "unknown"
+# multihost journals can be merged and re-grouped per host offline.  The
+# env override exists for simulated multi-host runs (CI's live-plane gate
+# runs two "hosts" as subprocesses of one machine) — a real pod never
+# needs it
+_HOST = os.environ.get("DA_TPU_TELEMETRY_HOST") or ""
+if not _HOST:
+    try:
+        import socket as _socket
+        _HOST = _socket.gethostname() or "unknown"
+    except Exception:  # pragma: no cover
+        _HOST = "unknown"
 
 # the innermost open tracing span (telemetry/tracing.py) on this
 # thread/context — read here so events and comm records are stamped with

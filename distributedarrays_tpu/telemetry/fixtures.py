@@ -87,8 +87,13 @@ def telemetry_capture(tmp_path):
     facade (plus ``spans()`` / ``assert_span()``); the journal path is
     ``telemetry.journal_path()``.
     """
+    from . import stream
     prev_enabled = core.enabled()
     prev_path = core.journal_path()
+    # stream._reset joins the exporter thread, which may itself be
+    # waiting on core._LOCK — so it must run OUTSIDE core.reset's hook
+    # list (reset hooks run under the lock), here in plain teardown
+    stream._reset()
     core.reset()
     core.configure(str(tmp_path / "journal.jsonl"))
     core.enable()
@@ -96,6 +101,7 @@ def telemetry_capture(tmp_path):
         from distributedarrays_tpu import telemetry
         yield TelemetryCapture(telemetry)
     finally:
+        stream._reset()
         core.reset()
         core.configure(prev_path)
         if prev_enabled:

@@ -142,6 +142,14 @@ def snapshot_bundle(reason: str, exc=None) -> dict:
             verdict = _classifier(exc)
         except Exception:
             verdict = None               # the recorder must never re-crash
+    try:
+        # the live plane's last drop/lag counters: a postmortem must show
+        # whether streamed telemetry was degraded at crash time (counted
+        # drops mean the aggregator's view of the final seconds is partial)
+        from . import stream as _stream
+        stream_stats = _stream.stats()
+    except Exception:
+        stream_stats = {"armed": False}
     return {
         "kind": "da_tpu_postmortem",
         "schema_version": SCHEMA_VERSION,
@@ -162,6 +170,7 @@ def snapshot_bundle(reason: str, exc=None) -> dict:
         "leak_census": leak,
         "divergence": [e for e in ring if e.get("cat") == "divergence"],
         "journal_path": core.journal_path(),
+        "stream": stream_stats,
     }
 
 

@@ -53,6 +53,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import layout as L
 from .. import telemetry as _tm
+from ..telemetry import stream as _tstream
 from ..darray import distribute
 from ..parallel.collectives import shard_map_compat
 from ..resilience import elastic, faults, recovery
@@ -430,6 +431,9 @@ class Trainer:
             # last step wall time as a gauge: the alerts module's
             # train_step_time burn-rate rule samples it between spans
             _tm.set_gauge("train.step_s", round(dur, 6))
+            # live plane: per-step timing points for the aggregator's
+            # train_step_time burn windows (single check when unarmed)
+            _tstream.note("train.step_s", round(dur, 6))
             # straggler gate BEFORE the update is applied: a confirmed
             # dead rank must abort the step so the recovery retry
             # (restore + shrink) recomputes it — never double-applies

@@ -61,6 +61,8 @@ MODULES = [
     "distributedarrays_tpu.telemetry.cluster",
     "distributedarrays_tpu.telemetry.alerts",
     "distributedarrays_tpu.telemetry.advisor",
+    "distributedarrays_tpu.telemetry.stream",
+    "distributedarrays_tpu.telemetry.agg",
     "distributedarrays_tpu.analysis",
     "distributedarrays_tpu.analysis.divergence",
     "distributedarrays_tpu.analysis.protocol",

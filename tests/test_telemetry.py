@@ -678,6 +678,25 @@ r = telemetry.report()
 print("REPORT " + json.dumps(r))
 pm = telemetry.postmortem()
 print("PM " + json.dumps(pm is not None))
+# live plane: exporter + aggregator collapse to the same boolean check
+from distributedarrays_tpu.telemetry import agg, stream
+exp = stream.start("127.0.0.1:1")
+stream.note("workload.gauge", 1.0)
+stream.poke()
+print("STREAM_START " + json.dumps(exp is not None))
+print("STREAM_ARMED " + json.dumps(stream.armed()))
+print("STREAM_STATS " + json.dumps(stream.stats()))
+import urllib.error, urllib.request
+srv = agg.AggServer(port=0).start()
+try:
+    try:
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as resp:
+            print("AGG_METRICS " + str(resp.status))
+    except urllib.error.HTTPError as e:
+        print("AGG_METRICS " + str(e.code))
+finally:
+    srv.close()
+stream.stop()
 """
 
 
@@ -708,6 +727,12 @@ def test_scripted_workload_acceptance(tmp_path):
     assert rep["memory"]["tracked_arrays"] >= 4
     # on-demand postmortem wrote a bundle (journal dir is configured)
     assert "PM true" in r.stdout
+    # the live plane arms when telemetry is on: exporter starts (even
+    # with an unreachable aggregator — it drops, never stalls) and the
+    # aggregator serves its scrape endpoint
+    assert "STREAM_START true" in r.stdout
+    assert "STREAM_ARMED true" in r.stdout
+    assert "AGG_METRICS 200" in r.stdout
     # the journal file round-trips through the summarizer
     s = summarize(read_journal(str(jpath)))
     assert s["comm"]["by_kind"]["reshard"]["ops"] >= 1
@@ -764,5 +789,12 @@ def test_scripted_workload_disabled_is_silent(tmp_path):
     assert rep["memory"]["staging"]["peak_bytes"] == 0
     # and the flight recorder refuses to bundle
     assert "PM false" in r.stdout
+    # the live plane collapses to the same single boolean check: the
+    # exporter refuses to arm, note/poke are no-ops, stats is the
+    # disarmed sentinel, and the aggregator's endpoints refuse cleanly
+    assert "STREAM_START false" in r.stdout
+    assert "STREAM_ARMED false" in r.stdout
+    assert 'STREAM_STATS {"armed": false}' in r.stdout
+    assert "AGG_METRICS 503" in r.stdout
     assert not jpath.exists(), \
         "DA_TPU_TELEMETRY=0 must not create a journal file"
